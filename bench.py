@@ -1,0 +1,81 @@
+"""Benchmark: aggregate agent-serving decode throughput (tok/s).
+
+Mirrors the BASELINE.json north-star shape — N concurrent coding-agent
+sessions decoding against one shared model — scaled to the chips actually
+present. The 8-chip target is 1500 aggregate tok/s for Llama-3-8B on v5e-8;
+``vs_baseline`` compares against the pro-rata per-chip share of that target
+(1500 * n_chips / 8).
+
+Round-1 note: a single v5e chip (16 GB HBM) cannot hold Llama-3-8B bf16, so
+the single-chip benchmark serves the Llama-3.2-1B shape; the JSON labels the
+model so the number is not mistaken for an 8B measurement.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N, ...}
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from kukeon_tpu.models import llama
+    from kukeon_tpu.parallel import make_mesh, auto_mesh_shape
+    from kukeon_tpu.serving import SamplingParams, ServingEngine
+
+    backend = jax.default_backend()
+    n_chips = len(jax.devices())
+
+    if backend == "cpu":
+        cfg = llama.llama_tiny()
+        sessions, prompt_len, new_tokens, max_seq = 2, 32, 16, 128
+        model_name = "tiny (cpu smoke)"
+    else:
+        cfg = llama.llama3_1b()
+        sessions, prompt_len, new_tokens, max_seq = 4, 128, 128, 1024
+        model_name = "llama3.2-1b-shape"
+
+    shape = auto_mesh_shape(n_chips)
+    mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
+
+    params = llama.init_params(jax.random.key(0), cfg)
+    engine = ServingEngine(
+        cfg, params, mesh, num_slots=sessions, max_seq_len=max_seq
+    )
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=prompt_len).astype(np.int32)
+        for _ in range(sessions)
+    ]
+    sp = SamplingParams(max_new_tokens=new_tokens)
+
+    # Warmup: compile prefill (same bucket as the measured prompts), insert,
+    # and the decode-chunk programs.
+    engine.warmup(prompt_len, sp)
+
+    t0 = time.monotonic()
+    reqs = [engine.submit(p, sp) for p in prompts]
+    while not all(r.done.is_set() for r in reqs):
+        engine.step()
+    dt = time.monotonic() - t0
+
+    total_tokens = sum(len(r.generated) for r in reqs)
+    toks_per_s = total_tokens / dt
+
+    baseline_share = 1500.0 * n_chips / 8.0
+    print(json.dumps({
+        "metric": "aggregate decode tok/s, %d concurrent sessions, %s, %d chip(s) [%s]"
+                  % (sessions, model_name, n_chips, backend),
+        "value": round(toks_per_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(toks_per_s / baseline_share, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

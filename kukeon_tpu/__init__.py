@@ -1,0 +1,19 @@
+"""kukeon-tpu: a TPU-native runtime for AI agent workloads.
+
+A ground-up rebuild of the capabilities of eminwux/kukeon (a single-host
+containerd "cell" runtime for AI coding agents) designed TPU-first:
+
+- ``kukeon_tpu.models`` / ``ops`` / ``parallel`` / ``serving`` / ``training``:
+  the JAX/XLA/Pallas compute path — the in-tree model-serving engine that
+  runs inside model cells (the reference has no model math; the TPU build's
+  north star adds an in-tree JetStream-style serving cell — see BASELINE.json).
+- ``kukeon_tpu.runtime``: the orchestration control plane — manifests,
+  daemon, controller, reconciler, cells, secrets, volumes, teams — the
+  capability-parity layer with the reference's Go daemon (kukeond).
+
+The compute path is pure JAX: SPMD over a ``jax.sharding.Mesh``, pjit/GSPMD
+sharding for tensor/data/FSDP parallelism, ``shard_map`` + ``ppermute`` ring
+attention for sequence parallelism, and Pallas kernels for the hot ops.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+from kukeon_tpu.models.llama import (  # noqa: F401
+    KVCache,
+    LlamaConfig,
+    forward,
+    init_params,
+    llama3_1b,
+    llama3_8b,
+    llama_tiny,
+)

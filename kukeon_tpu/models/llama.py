@@ -1,0 +1,256 @@
+"""Llama-family decoder model, functional JAX.
+
+This is the flagship model of the in-tree serving path (BASELINE.json north
+star: Llama-3-8B agent serving on a v5e slice). Design points, TPU-first:
+
+- **Pure functional**: params are a plain pytree dict; the forward is a pure
+  function — trivially jittable, shardable, and checkpointable.
+- **Stacked layers + ``lax.scan``**: all transformer blocks share one set of
+  stacked weights ([L, ...] leading axis) and run under ``lax.scan``, so
+  compile time and HLO size are O(1) in depth instead of O(L).
+- **bf16 weights/activations, f32 softmax & norms**: keeps matmuls on the MXU
+  while reductions stay numerically stable.
+- **GQA + RoPE + SwiGLU**: Llama-3 architecture (also covers Llama-2 shapes).
+- **Cache-aware**: the same ``forward`` covers prefill (no cache), cached
+  prefill, and single-token decode; cache layout is [L, B, S, KV, D] so the
+  scan carries per-layer cache slices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kukeon_tpu.ops.attention import gqa_attention
+from kukeon_tpu.ops.norms import rms_norm
+from kukeon_tpu.ops.rope import apply_rope
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500_000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def param_count(self) -> int:
+        embed = self.vocab_size * self.hidden_size
+        attn = self.hidden_size * (self.q_dim + 2 * self.kv_dim) + self.q_dim * self.hidden_size
+        mlp = 3 * self.hidden_size * self.intermediate_size
+        norms = 2 * self.hidden_size
+        head = 0 if self.tie_embeddings else embed
+        return embed + self.num_layers * (attn + mlp + norms) + self.hidden_size + head
+
+
+# --- Presets -----------------------------------------------------------------
+
+def llama3_8b() -> LlamaConfig:
+    return LlamaConfig()
+
+
+def llama3_1b() -> LlamaConfig:
+    """Llama-3.2-1B shapes — fits one v5e chip in bf16 with headroom."""
+    return LlamaConfig(
+        vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+        num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+        tie_embeddings=True,
+    )
+
+
+def llama_tiny() -> LlamaConfig:
+    """Test-size config: runs fast on a CPU mesh."""
+    return LlamaConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,
+        rope_theta=10_000.0, max_seq_len=256, dtype=jnp.float32,
+        tie_embeddings=True,
+    )
+
+
+# --- Init --------------------------------------------------------------------
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+    """Random-init a parameter pytree.
+
+    Layout (stacked layers on axis 0):
+      embed:   [V, H]
+      layers:  attn_norm [L, H], wq [L, H, NH*D], wk/wv [L, H, KV*D],
+               wo [L, NH*D, H], mlp_norm [L, H],
+               w_gate/w_up [L, H, I], w_down [L, I, H]
+      final_norm: [H]
+      lm_head: [H, V] (absent when tie_embeddings)
+    """
+    c = cfg
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(k, shape, fan_in):
+        scale = fan_in ** -0.5
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(c.dtype)
+
+    L, H, I, V = c.num_layers, c.hidden_size, c.intermediate_size, c.vocab_size
+    params: Params = {
+        "embed": dense(next(keys), (V, H), H),
+        "layers": {
+            "attn_norm": jnp.ones((L, H), c.dtype),
+            "wq": dense(next(keys), (L, H, c.q_dim), H),
+            "wk": dense(next(keys), (L, H, c.kv_dim), H),
+            "wv": dense(next(keys), (L, H, c.kv_dim), H),
+            "wo": dense(next(keys), (L, c.q_dim, H), c.q_dim),
+            "mlp_norm": jnp.ones((L, H), c.dtype),
+            "w_gate": dense(next(keys), (L, H, I), H),
+            "w_up": dense(next(keys), (L, H, I), H),
+            "w_down": dense(next(keys), (L, I, H), I),
+        },
+        "final_norm": jnp.ones((H,), c.dtype),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = dense(next(keys), (H, V), H)
+    return params
+
+
+# --- KV cache ----------------------------------------------------------------
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """Decode cache. k/v: [L, B, S_max, KV, D]; lengths: [B] used slots."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    lengths: jnp.ndarray
+
+    @staticmethod
+    def create(cfg: LlamaConfig, batch: int, max_len: int, dtype=None) -> "KVCache":
+        dtype = dtype or cfg.dtype
+        shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+        return KVCache(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            lengths=jnp.zeros((batch,), jnp.int32),
+        )
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def _cache_insert(cache_kv: jnp.ndarray, new_kv: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
+    """Insert [B, S, KV, D] at per-batch ``offsets`` into [B, S_max, KV, D]."""
+
+    def insert_one(slot, kv, off):
+        return jax.lax.dynamic_update_slice(slot, kv, (off, 0, 0))
+
+    return jax.vmap(insert_one)(cache_kv, new_kv, offsets)
+
+
+# --- Forward -----------------------------------------------------------------
+
+def forward(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    cache: KVCache | None = None,
+    attn_impl: str = "auto",
+) -> tuple[jnp.ndarray, KVCache | None]:
+    """Run the decoder.
+
+    Args:
+      params: pytree from :func:`init_params`.
+      tokens: [B, S] int32 token ids.
+      positions: [B, S] absolute positions of those tokens.
+      cache: optional KVCache; when given, new K/V are written at each
+        sequence's current length and attention runs against the cache.
+        ``positions`` must equal ``cache.lengths[:, None] + arange(S)``.
+
+    Returns:
+      (logits [B, S, V] float32, updated cache or None).
+    """
+    c = cfg
+    B, S = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0).astype(c.dtype)  # [B, S, H]
+
+    offsets = cache.lengths if cache is not None else None
+
+    def layer_step(x, layer):
+        w, layer_cache = layer
+        # Attention block.
+        h = rms_norm(x, w["attn_norm"], c.rms_norm_eps)
+        q = (h @ w["wq"]).reshape(B, S, c.num_heads, c.head_dim)
+        k = (h @ w["wk"]).reshape(B, S, c.num_kv_heads, c.head_dim)
+        v = (h @ w["wv"]).reshape(B, S, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, positions, c.rope_theta)
+        k = apply_rope(k, positions, c.rope_theta)
+
+        if layer_cache is not None:
+            ck, cv = layer_cache
+            ck = _cache_insert(ck, k, offsets)
+            cv = _cache_insert(cv, v, offsets)
+            kv_positions = jnp.broadcast_to(
+                jnp.arange(ck.shape[1], dtype=jnp.int32)[None, :], (B, ck.shape[1])
+            )
+            kv_length = offsets + S
+            attn = gqa_attention(
+                q, ck, cv,
+                q_positions=positions, kv_positions=kv_positions,
+                kv_length=kv_length, impl=attn_impl,
+            )
+            new_layer_cache = (ck, cv)
+        else:
+            attn = gqa_attention(
+                q, k, v,
+                q_positions=positions, kv_positions=positions, impl=attn_impl,
+            )
+            new_layer_cache = None
+
+        attn = attn.reshape(B, S, c.q_dim) @ w["wo"]
+        x = x + attn
+
+        # MLP block (SwiGLU).
+        h = rms_norm(x, w["mlp_norm"], c.rms_norm_eps)
+        gate = jax.nn.silu((h @ w["w_gate"]).astype(jnp.float32)).astype(c.dtype)
+        up = h @ w["w_up"]
+        x = x + (gate * up) @ w["w_down"]
+        return x, new_layer_cache
+
+    layer_ws = params["layers"]
+    if cache is not None:
+        x, (new_k, new_v) = jax.lax.scan(
+            lambda carry, layer: layer_step(carry, (layer[0], (layer[1], layer[2]))),
+            x,
+            (layer_ws, cache.k, cache.v),
+        )
+        new_cache = KVCache(k=new_k, v=new_v, lengths=cache.lengths + S)
+    else:
+        x, _ = jax.lax.scan(
+            lambda carry, w: layer_step(carry, (w, None)), x, layer_ws
+        )
+        new_cache = None
+
+    x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    if c.tie_embeddings:
+        logits = jnp.einsum("bsh,vh->bsv", x, params["embed"])
+    else:
+        logits = x @ params["lm_head"]
+    return logits.astype(jnp.float32), new_cache

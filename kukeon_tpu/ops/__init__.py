@@ -1,0 +1,3 @@
+from kukeon_tpu.ops.attention import gqa_attention, attention_reference  # noqa: F401
+from kukeon_tpu.ops.norms import rms_norm  # noqa: F401
+from kukeon_tpu.ops.rope import apply_rope  # noqa: F401

@@ -1,0 +1,129 @@
+"""Attention ops: XLA-fused reference path and Pallas flash dispatch.
+
+Grouped-query attention (GQA) with a position-based mask, which uniformly
+covers:
+  - full causal self-attention (prefill / training),
+  - decode-against-cache (each query attends to cache slots with
+    key_position <= query_position and slot < used length).
+
+The reference path is plain einsum + softmax: XLA fuses this well on TPU and
+keeps the matmuls on the MXU. The Pallas flash kernel
+(:mod:`kukeon_tpu.ops.flash_attention`) is used for long-sequence prefill and
+training on TPU, where materializing the [S, S] score matrix would blow HBM
+bandwidth.
+"""
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """Expand KV heads for GQA: [B, S, KV, D] -> [B, S, KV * n_rep, D]."""
+    if n_rep == 1:
+        return x
+    b, s, kv, d = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, kv, n_rep, d))
+    return x.reshape(b, s, kv * n_rep, d)
+
+
+def attention_mask(
+    q_positions: jnp.ndarray,
+    kv_positions: jnp.ndarray,
+    kv_length: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Boolean mask [B, 1, Sq, Skv]: True = attend.
+
+    Args:
+      q_positions: [B, Sq] absolute positions of the queries.
+      kv_positions: [B, Skv] absolute positions of the keys.
+      kv_length: optional [B] number of valid cache slots; slots at index >=
+        kv_length are masked out (used when attending to a fixed-size cache).
+    """
+    causal = kv_positions[:, None, :] <= q_positions[:, :, None]  # [B, Sq, Skv]
+    if kv_length is not None:
+        skv = kv_positions.shape[-1]
+        valid = jnp.arange(skv)[None, None, :] < kv_length[:, None, None]
+        causal = jnp.logical_and(causal, valid)
+    return causal[:, None, :, :]
+
+
+def attention_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Masked multi-head attention via einsum (GQA-expanded inputs).
+
+    Args:
+      q: [B, Sq, H, D]; k, v: [B, Skv, H, D]; mask: [B, 1, Sq, Skv] bool.
+
+    Returns:
+      [B, Sq, H, D] in q's dtype. Softmax is computed in float32.
+    """
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def gqa_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    q_positions: jnp.ndarray,
+    kv_positions: jnp.ndarray,
+    kv_length: jnp.ndarray | None = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """GQA attention entry point used by the model.
+
+    q: [B, Sq, NH, D]; k, v: [B, Skv, NKV, D] with NH % NKV == 0.
+
+    ``impl``: "auto" picks flash on TPU for long-enough sequences, else the
+    XLA reference; "reference" / "flash" / "ring" force a path. "ring" is the
+    sequence-parallel path (shard_map + ppermute over the ``seq`` mesh axis)
+    and requires an ambient mesh (``jax.set_mesh``) with a ``seq`` axis.
+    """
+    if impl == "ring":
+        from kukeon_tpu.parallel.ring_attention import ring_attention
+
+        return ring_attention(
+            q, k, v, q_positions=q_positions, kv_positions=kv_positions
+        )
+
+    n_heads = q.shape[2]
+    n_kv = k.shape[2]
+    k = repeat_kv(k, n_heads // n_kv)
+    v = repeat_kv(v, n_heads // n_kv)
+
+    from kukeon_tpu.ops import flash_attention as fa
+
+    use_flash = False
+    if impl == "flash":
+        use_flash = True
+    elif impl == "auto":
+        # Flash pays off when the score matrix is big; decode (Sq==1), tiny
+        # prefills, cache attention, and non-TPU backends stay on the fused
+        # XLA path.
+        # Measured on v5e: parity at S=2048, 27x at S=8192 (the XLA path
+        # materializes the [S, S] scores); flash also saves the O(S^2) HBM.
+        use_flash = (
+            kv_length is None
+            and q.shape[1] >= 1024
+            and fa.supports(q.shape[1], k.shape[1])
+            and jax.default_backend() == "tpu"
+        )
+
+    if use_flash:
+        return fa.flash_attention(q, k, v)
+
+    mask = attention_mask(q_positions, kv_positions, kv_length)
+    return attention_reference(q, k, v, mask)

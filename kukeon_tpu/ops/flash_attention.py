@@ -1,0 +1,161 @@
+"""Pallas TPU flash attention (causal, forward).
+
+Online-softmax tiled attention: grid (batch*heads, q_blocks, kv_blocks) with
+the kv dimension innermost/sequential; running max/sum/accumulator live in
+VMEM scratch across kv steps, so the [S, S] score matrix never touches HBM.
+Fully-masked kv blocks (kv_start > q_end) are predicated out with ``pl.when``.
+
+Scope: self-attention with row/column positions equal to ``arange(S)``
+(training and uncached prefill — exactly where the dispatcher uses it; the
+decode path attends against a cache and stays on the fused XLA path). For
+the backward pass the caller wraps attention in ``jax.checkpoint`` and this
+kernel is used for the recomputed forward; gradients flow through the XLA
+reference path via ``jax.custom_vjp`` fallback (see ``flash_attention``'s
+``@jax.custom_vjp`` definition).
+
+Block sizes default to 256x256 tiles over f32/bf16 inputs, clamped to the
+sequence length; sequences must divide by the block size (the dispatcher
+guarantees this by falling back to the reference path otherwise).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, block_q, block_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    kv_start = ki * block_k
+
+    # A kv block is live unless every (q, kv) pair in it is masked.
+    @pl.when(kv_start <= q_start + block_q - 1)
+    def _compute():
+        q = q_ref[0]                       # [bq, D]
+        k = k_ref[0]                       # [bk, D]
+        v = v_ref[0]                       # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                          # [bq, bk]
+
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + q_start
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + kv_start
+        s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]              # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)             # [bq, bk] f32
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+
+        acc = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        acc_scr[:] = acc
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, block_q: int, block_k: int, interpret: bool = False):
+    """q, k, v: [BH, S, D] (GQA-expanded, heads folded into batch)."""
+    BH, S, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    grid = (BH, S // block_q, S // block_k)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, block_q=block_q, block_k=block_k
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # running max
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, D), jnp.float32),       # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def supports(q_len: int, kv_len: int, block: int = 256) -> bool:
+    """Whether the kernel covers this shape (dispatcher guard)."""
+    if q_len != kv_len:
+        return False
+    b = min(block, q_len)
+    return q_len % b == 0 and q_len >= 128
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    block_q: int = 256,
+    block_k: int = 256,
+) -> jnp.ndarray:
+    """Causal flash attention. q, k, v: [B, S, H, D] (same head counts).
+
+    Positions are implicitly arange(S) per batch row — the dispatcher only
+    routes here for uncached self-attention.
+    """
+    B, S, H, D = q.shape
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    out = _flash_forward(fold(q), fold(k), fold(v), block_q=block_q, block_k=block_k)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, block_q, block_k):
+    return flash_attention(q, k, v, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(block_q, block_k, res, g):
+    """Backward via the XLA reference path (flash backward kernel: future
+    work; jax.checkpoint around layers keeps peak memory bounded anyway)."""
+    q, k, v = res
+
+    def ref(q, k, v):
+        from kukeon_tpu.ops.attention import attention_mask, attention_reference
+
+        B, S = q.shape[0], q.shape[1]
+        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+        return attention_reference(q, k, v, attention_mask(pos, pos))
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

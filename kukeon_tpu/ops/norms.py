@@ -1,0 +1,23 @@
+"""Normalization ops.
+
+RMSNorm as used by the Llama family. Computed in float32 regardless of input
+dtype (the usual TPU-stable recipe: bf16 activations, f32 reductions), then
+cast back so the surrounding matmuls stay bf16 on the MXU.
+"""
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm over the trailing dimension.
+
+    Args:
+      x: [..., hidden] activations (any float dtype).
+      scale: [hidden] learned gain.
+      eps: numerical-stability epsilon.
+    """
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * scale.astype(jnp.float32)).astype(orig_dtype)

@@ -1,0 +1,38 @@
+"""Rotary position embeddings (RoPE).
+
+Split-half convention (as in the Llama reference implementations): the head
+dimension is split into two halves that form the (real, imaginary) pair.
+Frequencies are computed in float32; the rotation is applied in float32 and
+cast back to the input dtype.
+"""
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
+    """Inverse frequencies [head_dim // 2] for a RoPE of base ``theta``."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponents)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotate q or k by position.
+
+    Args:
+      x: [batch, seq, heads, head_dim].
+      positions: [batch, seq] absolute token positions (int32).
+      theta: RoPE base frequency.
+
+    Returns:
+      Rotated array, same shape and dtype as ``x``.
+    """
+    head_dim = x.shape[-1]
+    inv_freq = rope_frequencies(head_dim, theta)           # [D/2]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]                   # [B, S, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)

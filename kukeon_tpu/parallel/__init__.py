@@ -1,0 +1,18 @@
+from kukeon_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    auto_mesh_shape,
+    make_mesh,
+    serving_mesh,
+    training_mesh,
+)
+from kukeon_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from kukeon_tpu.parallel.sharding import (  # noqa: F401
+    batch_spec,
+    kv_cache_spec,
+    llama_param_specs,
+    shard_params,
+    specs_for_params,
+)

@@ -1,0 +1,80 @@
+"""Device-mesh construction.
+
+Canonical axis names for the whole framework (the scaling-book convention):
+
+  - ``data``:    pure data parallelism (gradients all-reduced).
+  - ``fsdp``:    data parallelism with sharded params/optimizer state
+                 (params all-gathered per layer, grads reduce-scattered).
+  - ``tensor``:  tensor (megatron-style) parallelism inside a layer.
+  - ``seq``:     sequence/context parallelism (ring attention).
+
+Serving uses (data, tensor); training adds fsdp/seq. On a TPU slice the mesh
+should be laid out so that ``tensor`` (highest-bandwidth collectives) maps to
+the innermost ICI dimension — ``jax.make_mesh`` handles device ordering.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tensor"
+AXIS_SEQ = "seq"
+
+
+def make_mesh(
+    data: int = 1,
+    fsdp: int = 1,
+    tensor: int = 1,
+    seq: int = 1,
+    *,
+    devices=None,
+) -> Mesh:
+    """Build a mesh with the canonical axes; sizes must multiply to #devices."""
+    devices = devices if devices is not None else jax.devices()
+    want = data * fsdp * tensor * seq
+    if want != len(devices):
+        raise ValueError(
+            f"mesh {data}x{fsdp}x{tensor}x{seq}={want} != {len(devices)} devices"
+        )
+    # Auto axis types: GSPMD propagates shardings from the annotations we set
+    # at jit boundaries (jax 0.9 defaults to Explicit mode, which turns
+    # with_sharding_constraint into an assert — not what this codebase wants).
+    return jax.make_mesh(
+        (data, fsdp, seq, tensor),
+        (AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR),
+        devices=devices,
+        axis_types=(jax.sharding.AxisType.Auto,) * 4,
+    )
+
+
+def serving_mesh(n_devices: int | None = None) -> Mesh:
+    """All chips on ``tensor`` — the latency-optimal layout for one model."""
+    n = n_devices if n_devices is not None else len(jax.devices())
+    return make_mesh(tensor=n, devices=jax.devices()[:n])
+
+
+def training_mesh(n_devices: int | None = None, tensor: int = 1, seq: int = 1) -> Mesh:
+    """FSDP over whatever is left after tensor/seq axes."""
+    n = n_devices if n_devices is not None else len(jax.devices())
+    if n % (tensor * seq):
+        raise ValueError(f"{n} devices not divisible by tensor*seq={tensor * seq}")
+    return make_mesh(fsdp=n // (tensor * seq), tensor=tensor, seq=seq,
+                     devices=jax.devices()[:n])
+
+
+def largest_pow2_leq(n: int) -> int:
+    return 1 << (n.bit_length() - 1) if n > 0 else 1
+
+
+def auto_mesh_shape(n_devices: int) -> dict[str, int]:
+    """Heuristic serving layout: tensor up to 8 (one ICI ring), data beyond."""
+    tensor = min(8, largest_pow2_leq(n_devices))
+    data = n_devices // tensor
+    if tensor * data != n_devices:
+        tensor, data = n_devices, 1
+    return {"data": data, "tensor": tensor}

@@ -1,0 +1,86 @@
+"""Sharding rules: param/activation PartitionSpecs for the Llama family.
+
+GSPMD-style: we annotate shardings on the pytrees and jit boundaries and let
+XLA insert the collectives (all-gather / reduce-scatter / all-reduce over
+ICI). The megatron pattern for one transformer block needs exactly one
+all-reduce per attention block and one per MLP block in forward:
+
+  - wq/wk/wv and w_gate/w_up are sharded on their *output* dim ('tensor'),
+  - wo and w_down are sharded on their *input* dim ('tensor'),
+
+so the pair (column-parallel -> row-parallel) keeps activations sharded by
+head/intermediate between them, with a single psum at the end of each block.
+The embedding is vocab-sharded; the final projection gathers logits.
+
+FSDP shards every weight's largest remaining dim over 'fsdp'; XLA turns that
+into per-layer all-gathers (forward) and reduce-scatters (backward), which
+overlap with compute on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kukeon_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR
+
+
+def llama_param_specs(fsdp: bool = False) -> dict:
+    """PartitionSpec pytree matching the layout of models.llama.init_params.
+
+    Stacked-layer weights have a leading [L] axis that is always replicated
+    (the scan iterates over it).
+    """
+    f = AXIS_FSDP if fsdp else None
+    t = AXIS_TENSOR
+    specs = {
+        "embed": P(t, f),                       # vocab-sharded
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, f, t),                # column-parallel (heads)
+            "wk": P(None, f, t),
+            "wv": P(None, f, t),
+            "wo": P(None, t, f),                # row-parallel
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, f, t),            # column-parallel (intermediate)
+            "w_up": P(None, f, t),
+            "w_down": P(None, t, f),            # row-parallel
+        },
+        "final_norm": P(None),
+    }
+    # lm_head present only for untied configs; caller prunes to the actual tree.
+    specs["lm_head"] = P(f, t)
+    return specs
+
+
+def specs_for_params(params, fsdp: bool = False) -> dict:
+    """Prune the full spec tree to the keys present in ``params``."""
+    full = llama_param_specs(fsdp)
+    return {k: full[k] for k in params}
+
+
+def shard_params(params, mesh: Mesh, fsdp: bool = False):
+    """Device-put a param pytree with the canonical shardings."""
+    specs = specs_for_params(params, fsdp)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.device_put(params, shardings)
+
+
+def batch_spec() -> P:
+    """Tokens/positions: batch over (data, fsdp), sequence over seq axis."""
+    return P((AXIS_DATA, AXIS_FSDP), AXIS_SEQ)
+
+
+def kv_cache_spec(shard_batch: bool = False) -> "P":
+    """KVCache k/v [L, B, S, KV, D]: kv-heads on tensor; optionally batch on
+    data/fsdp (training-style). A serving engine is one model replica, so its
+    decode slots stay replicated — data parallelism means multiple engines."""
+    batch = (AXIS_DATA, AXIS_FSDP) if shard_batch else None
+    return P(None, batch, None, AXIS_TENSOR, None)
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)

@@ -1,0 +1,6 @@
+"""kukeon_tpu.runtime: the orchestration control plane (under construction).
+
+Capability-parity layer with the reference's Go daemon (kukeond): manifests,
+daemon, controller, reconciler, cells, secrets, volumes, teams. Built out
+incrementally; see the repo README for current status.
+"""

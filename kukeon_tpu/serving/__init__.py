@@ -1,0 +1,11 @@
+from kukeon_tpu.serving.engine import (  # noqa: F401
+    DecodeState,
+    Request,
+    ServingEngine,
+    bucket_length,
+)
+from kukeon_tpu.serving.sampling import (  # noqa: F401
+    SamplingParams,
+    sample,
+    sample_per_slot,
+)

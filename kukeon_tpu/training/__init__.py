@@ -1,0 +1,5 @@
+from kukeon_tpu.training.train_step import (  # noqa: F401
+    TrainState,
+    create_train_state,
+    make_train_step,
+)

@@ -1,0 +1,1 @@
+from kukeon_tpu.utils.tree import tree_size_bytes, tree_param_count  # noqa: F401

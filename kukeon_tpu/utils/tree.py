@@ -1,0 +1,23 @@
+"""Small pytree utilities shared across the compute path."""
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_param_count(tree) -> int:
+    """Total number of scalar parameters in a pytree."""
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+def tree_size_bytes(tree) -> int:
+    """Total in-memory size of a pytree of arrays, in bytes."""
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+
+def tree_cast(tree, dtype):
+    """Cast every floating-point leaf of a pytree to ``dtype``."""
+    def cast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree.map(cast, tree)

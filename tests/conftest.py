@@ -1,0 +1,22 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; all sharding/parallelism tests
+run against ``--xla_force_host_platform_device_count=8`` CPU devices, mirroring
+the reference's strategy of testing against fakes rather than real systems
+(reference: internal/ctr tests with fake containerd services,
+SURVEY.md section 4).
+
+Note: the axon TPU plugin registers itself via sitecustomize and pre-imports
+jax, so env vars alone are too late — ``jax.config.update`` is the reliable
+switch.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,82 @@
+"""Serving engine: continuous batching correctness on a CPU tensor mesh."""
+
+import jax
+import numpy as np
+import pytest
+
+from kukeon_tpu.models import llama
+from kukeon_tpu.parallel import make_mesh
+from kukeon_tpu.serving import SamplingParams, ServingEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    mesh = make_mesh(tensor=2, data=4)
+    return ServingEngine(cfg, params, mesh, num_slots=4, max_seq_len=128), cfg, params
+
+
+def _reference_greedy(cfg, params, prompt, n_new):
+    """Greedy decode via direct full forward passes (no cache)."""
+    import jax.numpy as jnp
+
+    tokens = list(prompt)
+    out = []
+    for _ in range(n_new):
+        t = jnp.asarray(tokens, jnp.int32)[None, :]
+        pos = jnp.arange(len(tokens), dtype=jnp.int32)[None, :]
+        logits, _ = llama.forward(params, cfg, t, pos)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        tokens.append(nxt)
+    return out
+
+
+def test_greedy_matches_uncached_reference(engine):
+    eng, cfg, params = engine
+    prompt = np.arange(1, 9, dtype=np.int32)  # 8 tokens
+    got = eng.generate(prompt, SamplingParams(max_new_tokens=8))
+    want = _reference_greedy(cfg, params, prompt, 8)
+    assert got == want
+
+
+def test_concurrent_requests_isolation(engine):
+    """4 concurrent requests must produce the same output as 4 serial ones."""
+    eng, cfg, params = engine
+    prompts = [np.arange(1 + i, 12 + i, dtype=np.int32) for i in range(4)]
+    serial = [eng.generate(p, SamplingParams(max_new_tokens=6)) for p in prompts]
+
+    reqs = [eng.submit(p, SamplingParams(max_new_tokens=6)) for p in prompts]
+    while not all(r.done.is_set() for r in reqs):
+        eng.step()
+    concurrent = [r.generated for r in reqs]
+    assert concurrent == serial
+
+
+def test_max_new_tokens_respected(engine):
+    eng, _, _ = engine
+    got = eng.generate(np.array([5, 6, 7], np.int32), SamplingParams(max_new_tokens=3))
+    assert len(got) == 3
+
+
+def test_sampling_temperature_differs(engine):
+    eng, _, _ = engine
+    prompt = np.arange(1, 20, dtype=np.int32)
+    a = eng.generate(prompt, SamplingParams(temperature=1.5, top_k=50, max_new_tokens=12))
+    b = eng.generate(prompt, SamplingParams(temperature=1.5, top_k=50, max_new_tokens=12))
+    assert len(a) == 12 and len(b) == 12
+    # Engine key advances between requests, so sampled outputs should differ.
+    assert a != b
+
+
+def test_background_thread_mode(engine):
+    eng, cfg, params = engine
+    eng.start()
+    try:
+        prompt = np.arange(3, 30, dtype=np.int32)
+        got = eng.generate(prompt, SamplingParams(max_new_tokens=5))
+        want = _reference_greedy(cfg, params, prompt, 5)
+        assert got == want
+    finally:
+        eng.stop()
