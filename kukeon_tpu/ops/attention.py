@@ -123,7 +123,7 @@ def gqa_attention(
         )
 
     if use_flash:
-        return fa.flash_attention(q, k, v)
+        return fa.flash_attention(q, k, v, q_positions, kv_positions)
 
     mask = attention_mask(q_positions, kv_positions, kv_length)
     return attention_reference(q, k, v, mask)

@@ -1,0 +1,315 @@
+"""Wire/YAML types for every manifest kind (v1beta1 equivalent).
+
+Parity surface with the reference's pkg/api/model/v1beta1 (11 kinds,
+consts.go:24-80; ContainerSpec field list container.go:34-237; SpaceSpec
+space.go:38-104; Volume volume.go:61-83), re-designed for a TPU-VM host:
+
+- ``Resources.tpu_chips`` is first-class: a container can request N chips;
+  the runner's device manager partitions chip visibility per cell the way
+  the reference partitions memory/cpu via cgroups (SURVEY.md section 5.8).
+- ``CellSpec.model`` declares an in-tree model-serving cell (the JetStream
+  analog from BASELINE.json's north star): the runner materializes a
+  serving container running kukeon_tpu.serving with the requested chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+API_VERSION = "kukeon.io/v1beta1"
+TEAMS_API_VERSION = "kuketeams.io/v1"
+
+KIND_REALM = "Realm"
+KIND_SPACE = "Space"
+KIND_STACK = "Stack"
+KIND_CELL = "Cell"
+KIND_CONTAINER = "Container"
+KIND_SECRET = "Secret"
+KIND_CELL_BLUEPRINT = "CellBlueprint"
+KIND_CELL_CONFIG = "CellConfig"
+KIND_VOLUME = "Volume"
+KIND_SERVER_CONFIGURATION = "ServerConfiguration"
+KIND_CLIENT_CONFIGURATION = "ClientConfiguration"
+
+ALL_KINDS = (
+    KIND_REALM, KIND_SPACE, KIND_STACK, KIND_CELL, KIND_CONTAINER,
+    KIND_SECRET, KIND_CELL_BLUEPRINT, KIND_CELL_CONFIG, KIND_VOLUME,
+    KIND_SERVER_CONFIGURATION, KIND_CLIENT_CONFIGURATION,
+)
+
+# Apply order: parents before children (reference: documents.go:30).
+KIND_APPLY_ORDER = (
+    KIND_REALM, KIND_SPACE, KIND_STACK, KIND_VOLUME, KIND_SECRET,
+    KIND_CELL_BLUEPRINT, KIND_CELL_CONFIG, KIND_CELL, KIND_CONTAINER,
+)
+
+
+@dataclass
+class Metadata:
+    name: str = ""
+    realm: str | None = None
+    space: str | None = None
+    stack: str | None = None
+    cell: str | None = None
+    labels: dict[str, str] = field(default_factory=dict)
+
+
+# --- container -----------------------------------------------------------
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class SecretRef:
+    """Mount a scoped Secret; staged read-only at /run/kukeon/secrets/<name>
+    (reference: ctr/secrets.go:30-60) and/or exported as env."""
+
+    name: str = ""
+    env: str | None = None           # export as this env var
+    path: str | None = None          # or stage at this path
+
+
+@dataclass
+class VolumeMount:
+    name: str | None = None          # reference to a Volume kind
+    host_path: str | None = None     # direct bind (trusted manifests only)
+    path: str = ""                   # mount point inside the workload
+    read_only: bool = False
+    tmpfs: bool = False
+
+
+@dataclass
+class PortSpec:
+    port: int = 0
+    protocol: str = "tcp"
+    name: str | None = None
+
+
+@dataclass
+class RepoSpec:
+    """Git repo cloned into the workload before start (kuketty runOn:create
+    stages; reference: cmd/kuketty/repos.go)."""
+
+    url: str = ""
+    path: str = ""
+    ref: str | None = None
+
+
+@dataclass
+class Resources:
+    memory: str | None = None        # e.g. "2Gi"
+    cpu: float | None = None         # cores
+    pids: int | None = None
+    tpu_chips: int | None = None     # TPU-native: chips granted to this container
+
+
+@dataclass
+class RestartPolicy:
+    policy: str = "never"            # always | on-failure | never
+    backoff_seconds: float = 1.0
+    max_retries: int | None = None
+
+
+@dataclass
+class TTYSpec:
+    prompt: str | None = None
+    on_init: list[str] = field(default_factory=list)   # stage commands
+    log_file: str | None = None
+    log_level: str | None = None
+
+
+@dataclass
+class ContainerSpec:
+    name: str = ""
+    image: str | None = None         # image-backed (containerd backend) or
+    command: list[str] = field(default_factory=list)   # process-backed
+    args: list[str] = field(default_factory=list)
+    env: list[EnvVar] = field(default_factory=list)
+    workdir: str | None = None
+    user: str | None = None
+    ports: list[PortSpec] = field(default_factory=list)
+    volumes: list[VolumeMount] = field(default_factory=list)
+    networks: list[str] = field(default_factory=list)
+    privileged: bool = False
+    host_network: bool = False
+    host_pid: bool = False
+    read_only_root_filesystem: bool = False
+    capabilities: list[str] = field(default_factory=list)
+    devices: list[str] = field(default_factory=list)
+    resources: Resources = field(default_factory=Resources)
+    secrets: list[SecretRef] = field(default_factory=list)
+    repos: list[RepoSpec] = field(default_factory=list)
+    restart_policy: RestartPolicy = field(default_factory=RestartPolicy)
+    attachable: bool = False
+    tty: TTYSpec | None = None
+
+
+# --- model-serving cell (TPU-native) -------------------------------------
+
+
+@dataclass
+class ModelSpec:
+    """In-tree serving cell: the runner materializes a container running the
+    kukeon_tpu serving engine with these settings (north-star JetStream
+    analog; no reference equivalent — kukeon has no model cells)."""
+
+    model: str = ""                  # e.g. "llama3-8b", "llama3-1b", "tiny"
+    chips: int = 1
+    port: int = 9000
+    num_slots: int = 8
+    max_seq_len: int | None = None
+    checkpoint: str | None = None    # orbax checkpoint dir; random-init if None
+    dtype: str | None = None
+
+
+# --- cell / hierarchy ----------------------------------------------------
+
+
+@dataclass
+class CellSpec:
+    containers: list[ContainerSpec] = field(default_factory=list)
+    model: ModelSpec | None = None
+    auto_delete: bool = False        # reap when root task exits (kuke run --rm)
+    ignore_disk_pressure: bool = False
+
+
+@dataclass
+class EgressRule:
+    host: str | None = None          # hostname, resolved at apply/reconcile
+    cidr: str | None = None
+    ports: list[int] = field(default_factory=list)
+
+
+@dataclass
+class NetworkSpec:
+    egress_default: str = "allow"    # allow | deny
+    egress_allow: list[EgressRule] = field(default_factory=list)
+
+
+@dataclass
+class SpaceSpec:
+    network: NetworkSpec = field(default_factory=NetworkSpec)
+    subnet: str | None = None        # auto-allocated from the pool if unset
+    container_defaults: ContainerSpec | None = None
+
+
+@dataclass
+class RealmSpec:
+    description: str | None = None
+
+
+@dataclass
+class StackSpec:
+    description: str | None = None
+
+
+# --- secrets / volumes ---------------------------------------------------
+
+
+@dataclass
+class SecretSpec:
+    data: dict[str, str] = field(default_factory=dict)   # plain values
+    # (the store chmods the staged file 0400 root-only, like the reference)
+
+
+@dataclass
+class VolumeSpec:
+    reclaim_policy: str = "delete"   # retain | delete (volume.go:61-83)
+    size: str | None = None
+
+
+# --- blueprints / configs ------------------------------------------------
+
+
+@dataclass
+class BlueprintParam:
+    name: str = ""
+    default: str | None = None
+    required: bool = False
+
+
+@dataclass
+class CellBlueprintSpec:
+    """Parametrized cell template; ``${param}`` scalars substituted at
+    materialization (reference: internal/cellblueprint/params.go:47-174)."""
+
+    params: list[BlueprintParam] = field(default_factory=list)
+    cell: CellSpec = field(default_factory=CellSpec)
+    name_prefix: str | None = None
+
+
+@dataclass
+class ConfigSecretBinding:
+    slot: str = ""                   # secret slot name in the blueprint
+    secret: str = ""                 # concrete Secret name
+
+
+@dataclass
+class CellConfigSpec:
+    """Binds a CellBlueprint to a concrete cell identity
+    (reference: internal/cellconfig/materialize.go:63-317)."""
+
+    blueprint: str = ""
+    values: dict[str, str] = field(default_factory=dict)
+    secrets: list[ConfigSecretBinding] = field(default_factory=list)
+    env: list[EnvVar] = field(default_factory=list)
+    cell_name: str | None = None     # deterministic name of the one live cell
+
+
+# --- configurations ------------------------------------------------------
+
+
+@dataclass
+class ServerConfigurationSpec:
+    run_path: str | None = None
+    socket: str | None = None
+    reconcile_interval_seconds: float | None = None
+    subnet_pool: str | None = None
+    disk_pressure_warn_pct: float | None = None
+    disk_pressure_block_pct: float | None = None
+    log_level: str | None = None
+
+
+@dataclass
+class ClientConfigurationSpec:
+    socket: str | None = None
+    default_realm: str | None = None
+    default_space: str | None = None
+    default_stack: str | None = None
+
+
+# --- document envelope ---------------------------------------------------
+
+SPEC_BY_KIND = {
+    KIND_REALM: RealmSpec,
+    KIND_SPACE: SpaceSpec,
+    KIND_STACK: StackSpec,
+    KIND_CELL: CellSpec,
+    KIND_CONTAINER: ContainerSpec,
+    KIND_SECRET: SecretSpec,
+    KIND_CELL_BLUEPRINT: CellBlueprintSpec,
+    KIND_CELL_CONFIG: CellConfigSpec,
+    KIND_VOLUME: VolumeSpec,
+    KIND_SERVER_CONFIGURATION: ServerConfigurationSpec,
+    KIND_CLIENT_CONFIGURATION: ClientConfigurationSpec,
+}
+
+
+@dataclass
+class Document:
+    api_version: str = API_VERSION
+    kind: str = ""
+    metadata: Metadata = field(default_factory=Metadata)
+    spec: object = None
+
+    def clone(self) -> "Document":
+        return dataclasses.replace(
+            self,
+            metadata=dataclasses.replace(self.metadata, labels=dict(self.metadata.labels)),
+            spec=dataclasses.replace(self.spec) if dataclasses.is_dataclass(self.spec) else self.spec,
+        )

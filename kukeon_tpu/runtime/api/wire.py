@@ -1,0 +1,105 @@
+"""Dataclass <-> camelCase-dict conversion for wire/YAML types.
+
+The manifest surface uses camelCase keys (``restartPolicy``, ``hostNetwork``)
+like the reference's YAML; Python code uses snake_case fields. This module
+provides the generic, typing-driven converter so each kind doesn't hand-roll
+(de)serialization. Unknown keys are rejected — manifests fail loudly on
+typos (the reference's parser does per-kind structural validation;
+internal/apply/parser/parser.go:220+).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import types as _types
+import typing
+from typing import Any, TypeVar
+
+from kukeon_tpu.runtime.errors import InvalidArgument
+
+T = TypeVar("T")
+
+
+def camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(w.capitalize() for w in rest)
+
+
+def _unwrap_optional(tp):
+    origin = typing.get_origin(tp)
+    if origin in (typing.Union, _types.UnionType):
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def to_wire(obj: Any) -> Any:
+    """Dataclass tree -> plain dict with camelCase keys; drops None/defaults-empty."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if v is None:
+                continue
+            if v == [] or v == {}:
+                continue
+            out[camel(f.name)] = to_wire(v)
+        return out
+    if isinstance(obj, list):
+        return [to_wire(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: to_wire(v) for k, v in obj.items()}
+    return obj
+
+
+def from_wire(cls: type[T], data: Any, context: str = "") -> T:
+    """camelCase dict -> dataclass, strict about unknown keys, recursive."""
+    if data is None:
+        data = {}
+    if not isinstance(data, dict):
+        raise InvalidArgument(f"{context or cls.__name__}: expected a mapping, got {type(data).__name__}")
+
+    fields = {camel(f.name): f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise InvalidArgument(
+            f"{context or cls.__name__}: unknown field(s) {sorted(unknown)}; "
+            f"known: {sorted(fields)}"
+        )
+
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for key, f in fields.items():
+        if key not in data:
+            continue
+        v = data[key]
+        kwargs[f.name] = _coerce(hints[f.name], v, f"{context or cls.__name__}.{key}")
+    try:
+        return cls(**kwargs)
+    except TypeError as e:
+        raise InvalidArgument(f"{context or cls.__name__}: {e}") from None
+
+
+def _coerce(tp, v, ctx: str):
+    tp = _unwrap_optional(tp)
+    if v is None:
+        return None
+    origin = typing.get_origin(tp)
+    if dataclasses.is_dataclass(tp):
+        return from_wire(tp, v, ctx)
+    if origin is list:
+        (item_tp,) = typing.get_args(tp)
+        if not isinstance(v, list):
+            raise InvalidArgument(f"{ctx}: expected a list")
+        return [_coerce(item_tp, x, f"{ctx}[{i}]") for i, x in enumerate(v)]
+    if origin is dict:
+        _, val_tp = typing.get_args(tp)
+        if not isinstance(v, dict):
+            raise InvalidArgument(f"{ctx}: expected a mapping")
+        return {k: _coerce(val_tp, x, f"{ctx}.{k}") for k, x in v.items()}
+    if tp is float and isinstance(v, int):
+        return float(v)
+    if tp in (int, str, bool, float) and not isinstance(v, tp):
+        raise InvalidArgument(f"{ctx}: expected {tp.__name__}, got {type(v).__name__}")
+    return v

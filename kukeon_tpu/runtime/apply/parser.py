@@ -1,0 +1,138 @@
+"""Manifest parsing: multi-doc YAML -> validated Documents.
+
+Reference: internal/apply/parser (parser.go:68 multi-doc split, :102 kind
+detection, :220-823 per-kind structural validation incl. scope rules).
+"""
+
+from __future__ import annotations
+
+import yaml
+
+from kukeon_tpu.runtime import naming
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.api.wire import from_wire
+from kukeon_tpu.runtime.errors import InvalidArgument
+
+# Scope requirements per kind: which metadata fields must / may be set.
+# (reference scope rules: Secret/Blueprint/Config scopable at realm/space/
+#  stack; Volume at realm/space/stack only — never cell.)
+_SCOPED_KINDS = {t.KIND_SECRET, t.KIND_CELL_BLUEPRINT, t.KIND_CELL_CONFIG, t.KIND_VOLUME}
+
+
+def parse_documents(blob: str, source: str = "<manifest>") -> list[t.Document]:
+    """Split a multi-doc YAML blob and parse/validate every document."""
+    docs: list[t.Document] = []
+    try:
+        raw_docs = list(yaml.safe_load_all(blob))
+    except yaml.YAMLError as e:
+        raise InvalidArgument(f"{source}: invalid YAML: {e}") from None
+    for i, raw in enumerate(raw_docs):
+        if raw is None:
+            continue
+        docs.append(parse_document(raw, f"{source}[{i}]"))
+    if not docs:
+        raise InvalidArgument(f"{source}: no documents found")
+    return docs
+
+
+def parse_document(raw: dict, context: str) -> t.Document:
+    if not isinstance(raw, dict):
+        raise InvalidArgument(f"{context}: document must be a mapping")
+    api_version = raw.get("apiVersion")
+    if api_version not in (t.API_VERSION, t.TEAMS_API_VERSION):
+        raise InvalidArgument(
+            f"{context}: unsupported apiVersion {api_version!r} (want {t.API_VERSION})"
+        )
+    kind = raw.get("kind")
+    if kind not in t.SPEC_BY_KIND:
+        raise InvalidArgument(
+            f"{context}: unknown kind {kind!r}; known: {sorted(t.SPEC_BY_KIND)}"
+        )
+    extra = set(raw) - {"apiVersion", "kind", "metadata", "spec"}
+    if extra:
+        raise InvalidArgument(f"{context}: unknown top-level field(s) {sorted(extra)}")
+
+    metadata = from_wire(t.Metadata, raw.get("metadata"), f"{context}.metadata")
+    spec = from_wire(t.SPEC_BY_KIND[kind], raw.get("spec"), f"{context}.spec")
+    doc = t.Document(api_version=api_version, kind=kind, metadata=metadata, spec=spec)
+    validate_document(doc, context)
+    return doc
+
+
+def validate_document(doc: t.Document, context: str = "") -> None:
+    ctx = context or f"{doc.kind}/{doc.metadata.name}"
+    md = doc.metadata
+    if doc.kind in (t.KIND_SERVER_CONFIGURATION, t.KIND_CLIENT_CONFIGURATION):
+        # Config documents are client/daemon-side files, not server resources
+        # (reference: consts.go — `kuke apply` rejects them). Parsed here for
+        # the config loaders; apply rejects them at a higher level.
+        return
+    naming.validate_name(md.name, f"{doc.kind} name")
+    for scope_field in ("realm", "space", "stack", "cell"):
+        v = getattr(md, scope_field)
+        if v is not None:
+            naming.validate_name(v, f"{doc.kind} {scope_field}")
+
+    if doc.kind == t.KIND_REALM:
+        _forbid_scope(md, ctx, "realm", "space", "stack", "cell")
+    elif doc.kind == t.KIND_SPACE:
+        _forbid_scope(md, ctx, "space", "stack", "cell")
+    elif doc.kind == t.KIND_STACK:
+        _forbid_scope(md, ctx, "stack", "cell")
+    elif doc.kind in (t.KIND_CELL, t.KIND_CONTAINER):
+        _forbid_scope(md, ctx, "cell")
+        if doc.kind == t.KIND_CELL:
+            _validate_cell_spec(doc.spec, ctx)
+    elif doc.kind in _SCOPED_KINDS:
+        if md.cell is not None:
+            raise InvalidArgument(f"{ctx}: {doc.kind} cannot be cell-scoped")
+        # stack scope requires space; space requires realm (when given).
+        if md.stack is not None and md.space is None:
+            raise InvalidArgument(f"{ctx}: stack scope requires space")
+        if doc.kind == t.KIND_VOLUME:
+            if doc.spec.reclaim_policy not in ("retain", "delete"):
+                raise InvalidArgument(
+                    f"{ctx}: reclaimPolicy must be retain|delete, got {doc.spec.reclaim_policy!r}"
+                )
+        if doc.kind == t.KIND_CELL_CONFIG and not doc.spec.blueprint:
+            raise InvalidArgument(f"{ctx}: CellConfig.spec.blueprint is required")
+
+
+def _forbid_scope(md: t.Metadata, ctx: str, *fields: str) -> None:
+    for f in fields:
+        if getattr(md, f) is not None:
+            raise InvalidArgument(f"{ctx}: metadata.{f} is not allowed for this kind")
+
+
+def _validate_cell_spec(spec: t.CellSpec, ctx: str) -> None:
+    if not spec.containers and spec.model is None:
+        raise InvalidArgument(f"{ctx}: cell needs containers or a model spec")
+    seen = set()
+    for c in spec.containers:
+        naming.validate_name(c.name, "container name")
+        if c.name in seen:
+            raise InvalidArgument(f"{ctx}: duplicate container name {c.name!r}")
+        seen.add(c.name)
+        if not c.command and not c.image:
+            raise InvalidArgument(
+                f"{ctx}: container {c.name!r} needs a command (process backend) or image"
+            )
+        if c.restart_policy.policy not in ("always", "on-failure", "never"):
+            raise InvalidArgument(
+                f"{ctx}: container {c.name!r}: restartPolicy.policy must be "
+                f"always|on-failure|never"
+            )
+        if c.resources.tpu_chips is not None and c.resources.tpu_chips < 0:
+            raise InvalidArgument(f"{ctx}: container {c.name!r}: tpuChips must be >= 0")
+    if spec.model is not None:
+        if spec.model.chips < 1:
+            raise InvalidArgument(f"{ctx}: model.chips must be >= 1")
+        if not spec.model.model:
+            raise InvalidArgument(f"{ctx}: model.model is required")
+
+
+def sort_documents(docs: list[t.Document], reverse: bool = False) -> list[t.Document]:
+    """Dependency order for apply (reverse for delete -f)."""
+    order = {k: i for i, k in enumerate(t.KIND_APPLY_ORDER)}
+    key = lambda d: order.get(d.kind, len(order))
+    return sorted(docs, key=key, reverse=reverse)

@@ -1,0 +1,82 @@
+"""Normalization & defaulting: wire docs -> canonical internal form.
+
+Reference: internal/apischeme (scheme.go:43-885) — validate + default every
+kind before the controller sees it. Scope fields default to the `default`
+realm/space/stack; space-level container defaults merge into each cell's
+containers; model cells get their serving-container shape validated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from kukeon_tpu.runtime import consts
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.errors import InvalidArgument
+
+
+def default_scope(md: t.Metadata, *, need_space: bool = True, need_stack: bool = True) -> t.Metadata:
+    md = dataclasses.replace(md, labels=dict(md.labels))
+    md.realm = md.realm or consts.DEFAULT_REALM
+    if need_space:
+        md.space = md.space or consts.DEFAULT_SPACE
+    if need_stack:
+        md.stack = md.stack or consts.DEFAULT_STACK
+    return md
+
+
+def normalize_cell(doc: t.Document, space_defaults: t.ContainerSpec | None = None) -> t.Document:
+    """Canonical cell doc: scope defaulted, container defaults merged."""
+    if doc.kind != t.KIND_CELL:
+        raise InvalidArgument(f"normalize_cell on kind {doc.kind}")
+    md = default_scope(doc.metadata)
+    spec: t.CellSpec = doc.spec
+    containers = [
+        _merge_container_defaults(c, space_defaults) for c in spec.containers
+    ]
+    spec = dataclasses.replace(spec, containers=containers)
+    return dataclasses.replace(doc, metadata=md, spec=spec)
+
+
+def _merge_container_defaults(
+    c: t.ContainerSpec, defaults: t.ContainerSpec | None
+) -> t.ContainerSpec:
+    if defaults is None:
+        return c
+    merged = dataclasses.replace(c)
+    if not merged.env and defaults.env:
+        merged.env = list(defaults.env)
+    elif defaults.env:
+        have = {e.name for e in merged.env}
+        merged.env = list(merged.env) + [e for e in defaults.env if e.name not in have]
+    if merged.resources.memory is None and defaults.resources.memory is not None:
+        merged.resources = dataclasses.replace(
+            merged.resources, memory=defaults.resources.memory
+        )
+    if merged.resources.cpu is None and defaults.resources.cpu is not None:
+        merged.resources = dataclasses.replace(merged.resources, cpu=defaults.resources.cpu)
+    if merged.workdir is None and defaults.workdir is not None:
+        merged.workdir = defaults.workdir
+    return merged
+
+
+def normalize_scoped(doc: t.Document) -> t.Document:
+    """Secrets / blueprints / configs / volumes: realm always set; finer
+    scopes only if given."""
+    md = dataclasses.replace(doc.metadata, labels=dict(doc.metadata.labels))
+    md.realm = md.realm or consts.DEFAULT_REALM
+    return dataclasses.replace(doc, metadata=md)
+
+
+def normalize(doc: t.Document) -> t.Document:
+    if doc.kind == t.KIND_REALM:
+        return doc
+    if doc.kind == t.KIND_SPACE:
+        return dataclasses.replace(doc, metadata=default_scope(doc.metadata, need_space=False, need_stack=False))
+    if doc.kind == t.KIND_STACK:
+        return dataclasses.replace(doc, metadata=default_scope(doc.metadata, need_stack=False))
+    if doc.kind == t.KIND_CELL:
+        return normalize_cell(doc)
+    if doc.kind in (t.KIND_SECRET, t.KIND_CELL_BLUEPRINT, t.KIND_CELL_CONFIG, t.KIND_VOLUME):
+        return normalize_scoped(doc)
+    return doc

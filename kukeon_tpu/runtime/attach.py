@@ -1,0 +1,112 @@
+"""Attach client: raw terminal <-> kuketty unix socket.
+
+Terminal bytes flow directly between this client and the in-cell kuketty —
+never through the daemon RPC — so daemon restarts don't drop live terminals
+(reference design point: cmd/kuke/attach/attach.go:17-23).
+
+Wire format to kuketty: [1B type][4B BE len][payload]; 'D' data, 'W' resize
+(u16 rows, u16 cols). Server->client is the raw PTY byte stream.
+Detach: Ctrl-] pressed twice in a row.
+"""
+
+from __future__ import annotations
+
+import os
+import select
+import signal
+import socket
+import struct
+import sys
+import termios
+import time
+import tty as tty_mod
+
+DETACH_KEY = b"\x1d"   # Ctrl-]
+PING_BUDGET_S = 10.0   # reference: run/attach.go:47-57
+PING_BACKOFF_S = 0.2
+
+
+def connect(socket_path: str, budget_s: float = PING_BUDGET_S) -> socket.socket:
+    """Dial with a retry budget (kuketty may still be claiming the socket)."""
+    deadline = time.monotonic() + budget_s
+    last_err: Exception | None = None
+    while time.monotonic() < deadline:
+        try:
+            s = socket.socket(socket.AF_UNIX)
+            s.connect(socket_path)
+            return s
+        except OSError as e:
+            last_err = e
+            time.sleep(PING_BACKOFF_S)
+    raise OSError(f"cannot reach terminal socket {socket_path}: {last_err}")
+
+
+def _send_frame(sock: socket.socket, typ: bytes, payload: bytes) -> None:
+    sock.sendall(typ + struct.pack(">I", len(payload)) + payload)
+
+
+def _send_winsize(sock: socket.socket) -> None:
+    try:
+        import fcntl
+
+        data = fcntl.ioctl(sys.stdout.fileno(), termios.TIOCGWINSZ, b"\x00" * 8)
+        rows, cols, _, _ = struct.unpack("HHHH", data)
+        _send_frame(sock, b"W", struct.pack(">HH", rows, cols))
+    except (OSError, ValueError):
+        pass
+
+
+def run_attach(socket_path: str, stdin=None, stdout=None) -> int:
+    """Interactive attach; returns 0 on detach, 1 if the session ended."""
+    stdin = stdin if stdin is not None else sys.stdin
+    stdout = stdout if stdout is not None else sys.stdout
+    sock = connect(socket_path)
+
+    interactive = stdin.isatty()
+    old_attrs = None
+    if interactive:
+        old_attrs = termios.tcgetattr(stdin.fileno())
+        # TCSADRAIN (not the default TCSAFLUSH): keystrokes typed while the
+        # client was starting up must not be discarded.
+        tty_mod.setraw(stdin.fileno(), termios.TCSADRAIN)
+        _send_winsize(sock)
+        signal.signal(signal.SIGWINCH, lambda *_: _send_winsize(sock))
+
+    pending = b""   # a trailing Ctrl-] held back from the previous read
+    rc = 1
+    try:
+        stdout.write("(attached — Ctrl-] Ctrl-] to detach)\r\n")
+        stdout.flush()
+        while True:
+            r, _, _ = select.select([sock, stdin], [], [])
+            if sock in r:
+                data = sock.recv(4096)
+                if not data:
+                    break   # workload exited / kuketty gone
+                stdout.buffer.write(data) if hasattr(stdout, "buffer") else stdout.write(
+                    data.decode(errors="replace")
+                )
+                stdout.flush()
+            if stdin in r:
+                data = os.read(stdin.fileno(), 4096)
+                if not data:
+                    break
+                combined = pending + data
+                if DETACH_KEY + DETACH_KEY in combined:
+                    before = combined.split(DETACH_KEY + DETACH_KEY, 1)[0]
+                    if before:
+                        _send_frame(sock, b"D", before)
+                    rc = 0
+                    break
+                if combined.endswith(DETACH_KEY):
+                    pending = DETACH_KEY   # hold it; maybe the pair completes
+                    combined = combined[:-1]
+                else:
+                    pending = b""
+                if combined:
+                    _send_frame(sock, b"D", combined)
+    finally:
+        if old_attrs is not None:
+            termios.tcsetattr(stdin.fileno(), termios.TCSADRAIN, old_attrs)
+        sock.close()
+    return rc

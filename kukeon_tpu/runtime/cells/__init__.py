@@ -1,0 +1,7 @@
+from kukeon_tpu.runtime.cells.backend import (  # noqa: F401
+    CellBackend,
+    ContainerContext,
+    ContainerState,
+)
+from kukeon_tpu.runtime.cells.fake import FakeBackend  # noqa: F401
+from kukeon_tpu.runtime.cells.process import ProcessBackend  # noqa: F401

@@ -1,0 +1,64 @@
+"""Cell backend interface — the containerd seam.
+
+Reference: internal/ctr/client.go:50-183 defines a Client interface wrapping
+containerd; everything above it (runner/controller) is backend-agnostic and
+unit-tested against fakes (SURVEY.md section 4). Same seam here:
+
+- :class:`ProcessBackend` runs workloads as supervised host processes
+  (kukeshim / kuketty native supervisors) — the in-sandbox / TPU-VM default;
+  a containerd-gRPC backend can slot in behind the same interface.
+- :class:`FakeBackend` is the in-memory test double.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.model import C_CREATED, C_EXITED, C_RUNNING
+
+
+@dataclass
+class ContainerContext:
+    """Everything a backend needs to run one container."""
+
+    container_dir: str                    # metadata dir (logs, pidfiles, tty)
+    spec: t.ContainerSpec = field(default_factory=t.ContainerSpec)
+    env: dict[str, str] = field(default_factory=dict)
+    command: list[str] = field(default_factory=list)
+    cgroup_dir: str | None = None
+    workdir: str | None = None
+
+
+@dataclass
+class ContainerState:
+    state: str = C_CREATED                # created | running | exited
+    pid: int | None = None
+    exit_code: int | None = None
+
+    @property
+    def running(self) -> bool:
+        return self.state == C_RUNNING
+
+    @property
+    def exited(self) -> bool:
+        return self.state == C_EXITED
+
+
+class CellBackend(abc.ABC):
+    @abc.abstractmethod
+    def start_container(self, ctx: ContainerContext) -> int:
+        """Start (or restart) the workload; returns supervisor/workload pid."""
+
+    @abc.abstractmethod
+    def signal_container(self, ctx: ContainerContext, sig: int) -> None:
+        """Deliver a signal to the workload (via its supervisor)."""
+
+    @abc.abstractmethod
+    def container_state(self, ctx: ContainerContext) -> ContainerState:
+        """Observe live state (survives daemon restarts)."""
+
+    @abc.abstractmethod
+    def cleanup_container(self, ctx: ContainerContext) -> None:
+        """Remove runtime droppings after the workload is gone."""

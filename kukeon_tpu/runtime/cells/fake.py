@@ -1,0 +1,76 @@
+"""In-memory fake backend for controller/runner unit tests.
+
+The reference tests its runner against scenario-scoped fake ctr.Clients
+(stopKillFakeClient etc., SURVEY.md section 4); this single configurable
+fake covers the same ground: scripted exits, start failures, signal log.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kukeon_tpu.runtime.cells.backend import (
+    CellBackend,
+    ContainerContext,
+    ContainerState,
+)
+from kukeon_tpu.runtime.errors import Unavailable
+from kukeon_tpu.runtime.model import C_CREATED, C_EXITED, C_RUNNING
+
+
+@dataclass
+class _Entry:
+    state: str = C_CREATED
+    pid: int = 0
+    exit_code: int | None = None
+    starts: int = 0
+    signals: list[int] = field(default_factory=list)
+
+
+class FakeBackend(CellBackend):
+    def __init__(self):
+        self.entries: dict[str, _Entry] = {}
+        self.fail_start: set[str] = set()        # container dirs that fail to start
+        self.auto_exit: dict[str, int] = {}      # dir -> exit code right after start
+        self._next_pid = 1000
+
+    def entry(self, ctx: ContainerContext) -> _Entry:
+        return self.entries.setdefault(ctx.container_dir, _Entry())
+
+    # --- CellBackend -------------------------------------------------------
+
+    def start_container(self, ctx: ContainerContext) -> int:
+        if ctx.container_dir in self.fail_start:
+            raise Unavailable(f"fake: start failure for {ctx.container_dir}")
+        e = self.entry(ctx)
+        e.starts += 1
+        self._next_pid += 1
+        e.pid = self._next_pid
+        if ctx.container_dir in self.auto_exit:
+            e.state = C_EXITED
+            e.exit_code = self.auto_exit[ctx.container_dir]
+        else:
+            e.state = C_RUNNING
+            e.exit_code = None
+        return e.pid
+
+    def signal_container(self, ctx: ContainerContext, sig: int) -> None:
+        e = self.entry(ctx)
+        e.signals.append(sig)
+        if e.state == C_RUNNING:
+            e.state = C_EXITED
+            e.exit_code = 128 + sig
+
+    def container_state(self, ctx: ContainerContext) -> ContainerState:
+        e = self.entry(ctx)
+        return ContainerState(e.state, pid=e.pid or None, exit_code=e.exit_code)
+
+    def cleanup_container(self, ctx: ContainerContext) -> None:
+        self.entries.pop(ctx.container_dir, None)
+
+    # --- test helpers ------------------------------------------------------
+
+    def exit(self, ctx_dir: str, code: int) -> None:
+        e = self.entries.setdefault(ctx_dir, _Entry())
+        e.state = C_EXITED
+        e.exit_code = code

@@ -1,0 +1,193 @@
+"""Process-backed cells: workloads supervised by the native shim binaries.
+
+Each container is a host process owned by a native supervisor that survives
+daemon restarts (the containerd-shim analog):
+
+- non-attachable -> ``kukeshim``: logs to container.log, exit code to the
+  exit file (the reference's cio.LogFile path, ctr/attachable.go:60-75);
+- attachable -> ``kuketty``: PTY + attach socket + capture transcript (the
+  reference's kuketty path).
+
+State is derived purely from on-disk artifacts (pidfile + exit file +
+/proc), so a restarted daemon re-derives truth the way the reference
+re-derives from containerd (SURVEY.md section 5.3).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+
+from kukeon_tpu.runtime import consts
+from kukeon_tpu.runtime.cells.backend import (
+    CellBackend,
+    ContainerContext,
+    ContainerState,
+)
+from kukeon_tpu.runtime.errors import FailedPrecondition
+from kukeon_tpu.runtime.model import C_CREATED, C_EXITED, C_RUNNING
+
+BIN_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bin")
+KUKESHIM = os.path.join(BIN_DIR, "kukeshim")
+KUKETTY = os.path.join(BIN_DIR, "kuketty")
+
+EXIT_FILE = "exit"
+SHIM_PID_FILE = "shim.pid"
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+class ProcessBackend(CellBackend):
+    def __init__(self, shim: str = KUKESHIM, tty: str = KUKETTY):
+        self.shim = shim
+        self.tty = tty
+
+    # --- paths -------------------------------------------------------------
+
+    @staticmethod
+    def paths(ctx: ContainerContext) -> dict[str, str]:
+        d = ctx.container_dir
+        return {
+            "log": os.path.join(d, consts.SHIM_LOG),
+            "capture": os.path.join(d, consts.CAPTURE_FILE),
+            "socket": os.path.join(d, consts.TTY_SOCKET),
+            "pid": os.path.join(d, consts.PID_FILE),
+            "shim_pid": os.path.join(d, SHIM_PID_FILE),
+            "exit": os.path.join(d, EXIT_FILE),
+        }
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def start_container(self, ctx: ContainerContext) -> int:
+        if not ctx.command:
+            raise FailedPrecondition(
+                f"container has no command (image-backed cells need the containerd backend)"
+            )
+        p = self.paths(ctx)
+        os.makedirs(ctx.container_dir, exist_ok=True)
+        # A fresh start invalidates previous run artifacts.
+        for stale in (p["exit"], p["pid"]):
+            try:
+                os.unlink(stale)
+            except FileNotFoundError:
+                pass
+
+        if ctx.spec.attachable:
+            argv = [self.tty, "--socket", p["socket"], "--capture", p["capture"],
+                    "--exit-file", p["exit"], "--pid-file", p["pid"]]
+            if ctx.spec.tty:
+                for stage in ctx.spec.tty.on_init:
+                    argv += ["--stage", stage]
+        else:
+            argv = [self.shim, "--log", p["log"],
+                    "--exit-file", p["exit"], "--pid-file", p["pid"]]
+        if ctx.workdir:
+            argv += ["--cwd", ctx.workdir]
+        if ctx.cgroup_dir:
+            argv += ["--cgroup", ctx.cgroup_dir]
+        argv += ["--"] + ctx.command
+
+        env = dict(os.environ)
+        env.update(ctx.env)
+        proc = subprocess.Popen(
+            argv,
+            env=env,
+            start_new_session=True,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            stdin=subprocess.DEVNULL,
+        )
+        with open(p["shim_pid"], "w") as f:
+            f.write(str(proc.pid))
+        # Don't hold the Popen: the supervisor outlives us by design. Hand it
+        # to a reaper-friendly close (init reaps if we die; if we live, the
+        # reconcile loop's poll() below collects it).
+        self._spawned = getattr(self, "_spawned", {})
+        self._spawned[proc.pid] = proc
+
+        # Wait briefly for the workload pidfile so immediate status reads see
+        # 'running' rather than a startup race.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if os.path.exists(p["pid"]) or os.path.exists(p["exit"]):
+                break
+            if proc.poll() is not None and not os.path.exists(p["exit"]):
+                break
+            time.sleep(0.01)
+        return proc.pid
+
+    def signal_container(self, ctx: ContainerContext, sig: int) -> None:
+        p = self.paths(ctx)
+        shim_pid = self._read_pid(p["shim_pid"])
+        workload_pid = self._read_pid(p["pid"])
+        # Signal the supervisor (it forwards TERM/INT); for KILL, hit the
+        # workload's process group directly — the supervisor then reports.
+        if sig in (signal.SIGTERM, signal.SIGINT) and shim_pid and _pid_alive(shim_pid):
+            os.kill(shim_pid, sig)
+            return
+        if workload_pid and _pid_alive(workload_pid):
+            try:
+                os.killpg(workload_pid, sig)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    os.kill(workload_pid, sig)
+                except ProcessLookupError:
+                    pass
+        elif shim_pid and _pid_alive(shim_pid):
+            os.kill(shim_pid, sig)
+
+    def container_state(self, ctx: ContainerContext) -> ContainerState:
+        p = self.paths(ctx)
+        self._reap()
+        if os.path.exists(p["exit"]):
+            try:
+                with open(p["exit"]) as f:
+                    code = int(f.read().strip())
+            except (OSError, ValueError):
+                code = None
+            return ContainerState(C_EXITED, exit_code=code)
+        pid = self._read_pid(p["pid"])
+        if pid and _pid_alive(pid):
+            return ContainerState(C_RUNNING, pid=pid)
+        shim_pid = self._read_pid(p["shim_pid"])
+        if shim_pid and _pid_alive(shim_pid):
+            # Supervisor up, workload pid not yet written: starting.
+            return ContainerState(C_RUNNING, pid=shim_pid)
+        if pid or shim_pid:
+            # Ran before but no exit file (crash/SIGKILL of the supervisor).
+            return ContainerState(C_EXITED, exit_code=None)
+        return ContainerState(C_CREATED)
+
+    def cleanup_container(self, ctx: ContainerContext) -> None:
+        p = self.paths(ctx)
+        for f in (p["socket"], p["pid"], p["shim_pid"], p["exit"]):
+            try:
+                os.unlink(f)
+            except FileNotFoundError:
+                pass
+
+    # --- helpers -----------------------------------------------------------
+
+    def _reap(self) -> None:
+        """Collect any finished supervisors we spawned (avoid zombies)."""
+        for pid, proc in list(getattr(self, "_spawned", {}).items()):
+            if proc.poll() is not None:
+                del self._spawned[pid]
+
+    @staticmethod
+    def _read_pid(path: str) -> int | None:
+        try:
+            with open(path) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None

@@ -1,0 +1,153 @@
+"""cgroup-v2 tree management for cells.
+
+Reference: internal/ctr/cgroups.go:44-484 (create/load/delete, subtree
+controller delegation incl. ancestors, metrics) + internal/cgroupcheck. The
+tree mirrors the hierarchy: <root>/kukeon/<realm>/<space>/<stack>/<cell>.
+Processes only ever join leaf cell cgroups, so the no-internal-process rule
+is satisfied by construction; controllers are delegated down the ancestor
+chain before a leaf is used.
+
+The root is injectable so tests run against a fake tempdir root (the
+reference tests cgroup logic against seeded tempdirs — cgroupcheck_test.go:85).
+"""
+
+from __future__ import annotations
+
+import os
+
+CONTROLLERS = ("cpu", "memory", "pids")
+
+
+class CgroupManager:
+    def __init__(self, root: str = "/sys/fs/cgroup", base: str = "kukeon"):
+        self.root = root
+        self.base = base
+
+    # --- availability ------------------------------------------------------
+
+    def available(self) -> bool:
+        try:
+            ctrl = os.path.join(self.root, "cgroup.controllers")
+            if not os.path.exists(ctrl):
+                return False
+            os.makedirs(os.path.join(self.root, self.base), exist_ok=True)
+            # Write-probe: delegation can make the dir creatable but the
+            # controller files read-only (the cgroup-namespace trap the
+            # reference disambiguates; internal/cgroupcheck/cgroupcheck.go).
+            probe = os.path.join(self.root, self.base, "cgroup.subtree_control")
+            with open(probe, "a"):
+                pass
+            return True
+        except OSError:
+            return False
+
+    def controllers(self) -> set[str]:
+        try:
+            with open(os.path.join(self.root, "cgroup.controllers")) as f:
+                return set(f.read().split())
+        except OSError:
+            return set()
+
+    # --- tree ops ----------------------------------------------------------
+
+    def path(self, *parts: str) -> str:
+        return os.path.join(self.root, self.base, *parts)
+
+    def ensure(self, *parts: str) -> str:
+        """Create the cgroup and delegate controllers down the chain."""
+        want = [c for c in CONTROLLERS if c in self.controllers()]
+        cur = os.path.join(self.root, self.base)
+        os.makedirs(cur, exist_ok=True)
+        chain = [cur]
+        for p in parts:
+            cur = os.path.join(cur, p)
+            os.makedirs(cur, exist_ok=True)
+            chain.append(cur)
+        # Enable controllers in every ancestor's subtree_control (leaf last,
+        # which never needs it since processes live there).
+        for d in chain[:-1]:
+            self._enable_subtree(d, want)
+        return chain[-1]
+
+    def _enable_subtree(self, d: str, controllers: list[str]) -> None:
+        if not controllers:
+            return
+        path = os.path.join(d, "cgroup.subtree_control")
+        try:
+            with open(path) as f:
+                have = set(f.read().split())
+        except OSError:
+            return
+        missing = [c for c in controllers if c not in have]
+        if not missing:
+            return
+        try:
+            with open(path, "w") as f:
+                f.write(" ".join(f"+{c}" for c in missing))
+        except OSError:
+            pass  # best-effort: limits degrade gracefully
+
+    def apply_limits(self, cgroup_dir: str, *, memory: str | None = None,
+                     cpu: float | None = None, pids: int | None = None) -> None:
+        if memory is not None:
+            self._write(cgroup_dir, "memory.max", str(parse_memory(memory)))
+        if cpu is not None:
+            period = 100_000
+            quota = int(cpu * period)
+            self._write(cgroup_dir, "cpu.max", f"{quota} {period}")
+        if pids is not None:
+            self._write(cgroup_dir, "pids.max", str(pids))
+
+    def metrics(self, cgroup_dir: str) -> dict:
+        out = {}
+        for name, key in (
+            ("memory.current", "memory_bytes"),
+            ("pids.current", "pids"),
+        ):
+            try:
+                with open(os.path.join(cgroup_dir, name)) as f:
+                    out[key] = int(f.read().strip())
+            except (OSError, ValueError):
+                pass
+        try:
+            with open(os.path.join(cgroup_dir, "cpu.stat")) as f:
+                for line in f:
+                    k, _, v = line.partition(" ")
+                    if k == "usage_usec":
+                        out["cpu_usec"] = int(v)
+        except OSError:
+            pass
+        return out
+
+    def remove(self, *parts: str) -> None:
+        """Remove a cgroup subtree (children first; dirs must be empty of
+        processes — callers stop tasks before removal)."""
+        top = self.path(*parts)
+        if not os.path.isdir(top):
+            return
+        for dirpath, dirnames, _ in os.walk(top, topdown=False):
+            del dirnames
+            try:
+                os.rmdir(dirpath)
+            except OSError:
+                pass
+
+    def _write(self, d: str, name: str, value: str) -> None:
+        try:
+            with open(os.path.join(d, name), "w") as f:
+                f.write(value)
+        except OSError:
+            pass
+
+
+def parse_memory(s: str) -> int:
+    """'2Gi' / '512Mi' / '100M' / bytes-as-int."""
+    s = s.strip()
+    units = {
+        "Ki": 1024, "Mi": 1024**2, "Gi": 1024**3, "Ti": 1024**4,
+        "K": 1000, "M": 1000**2, "G": 1000**3, "T": 1000**4,
+    }
+    for suffix in sorted(units, key=len, reverse=True):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * units[suffix])
+    return int(s)

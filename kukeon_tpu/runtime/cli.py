@@ -1,0 +1,579 @@
+"""kuke: the CLI (reference: cmd/kuke, 23 verbs).
+
+Verbs: init, daemon (serve/start/stop/status/logs), apply, delete, create,
+get, run, start, stop, kill, attach, log, purge, refresh, status, doctor,
+image (stub for the process backend), team, uninstall, version, autocomplete.
+
+Workload verbs route to the daemon; read/maintenance verbs "promote" to an
+in-process controller when --no-daemon / KUKEON_NO_DAEMON is set (reference
+process model: docs/site/architecture/process-model.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import yaml
+
+from kukeon_tpu import __version__
+from kukeon_tpu.runtime import consts
+from kukeon_tpu.runtime.client import LocalClient, UnixClient
+from kukeon_tpu.runtime.errors import KukeonError
+
+
+def _run_path(args) -> str:
+    return args.run_path or consts.env_run_path()
+
+
+def _client(args):
+    if getattr(args, "no_daemon", False) or os.environ.get("KUKEON_NO_DAEMON") == "true":
+        return LocalClient(_run_path(args))
+    sock = args.socket or os.environ.get("KUKEOND_SOCKET") or consts.socket_path(_run_path(args))
+    return UnixClient(sock)
+
+
+def _scope(args) -> dict:
+    return {
+        "realm": getattr(args, "realm", None) or consts.DEFAULT_REALM,
+        "space": getattr(args, "space", None) or consts.DEFAULT_SPACE,
+        "stack": getattr(args, "stack", None) or consts.DEFAULT_STACK,
+    }
+
+
+def _print(obj, as_json=False):
+    if as_json:
+        print(json.dumps(obj, indent=2))
+    else:
+        print(yaml.safe_dump(obj, sort_keys=False, default_flow_style=False).rstrip())
+
+
+# --- verb implementations ----------------------------------------------------
+
+def cmd_version(args):
+    del args
+    print(f"kuke {__version__} (kukeon-tpu)")
+    return 0
+
+
+def cmd_init(args):
+    """Host bootstrap: run path, hierarchy, daemon start (reference:
+    cmd/kuke/init, init.go:484)."""
+    run_path = _run_path(args)
+    os.makedirs(run_path, exist_ok=True)
+    local = LocalClient(run_path)     # bootstrap happens in the constructor
+    del local
+    print(f"Run path: {run_path}")
+    print(f"Realm: {consts.DEFAULT_REALM}")
+    print(f"System realm: {consts.SYSTEM_REALM}")
+    if not args.no_daemon_start:
+        rc = _daemon_start(run_path, args.socket)
+        if rc != 0:
+            return rc
+        print(f"kukeond is ready (unix://{args.socket or consts.socket_path(run_path)})")
+    return 0
+
+
+def _daemon_start(run_path: str, socket_path: str | None) -> int:
+    sock = socket_path or consts.socket_path(run_path)
+    if os.path.exists(sock):
+        try:
+            UnixClient(sock).call("Ping")
+            print("daemon already running")
+            return 0
+        except KukeonError:
+            pass
+    log_path = os.path.join(run_path, "kukeond.log")
+    with open(log_path, "a") as log:
+        subprocess.Popen(
+            [sys.executable, "-m", "kukeon_tpu.runtime.cli", "daemon", "serve",
+             "--run-path", run_path, "--socket", sock],
+            stdout=log, stderr=log, stdin=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+    deadline = time.monotonic() + 10.0   # reference: e2e daemon budget <=10s
+    while time.monotonic() < deadline:
+        try:
+            UnixClient(sock).call("Ping")
+            return 0
+        except KukeonError:
+            time.sleep(0.1)
+    print(f"error: daemon did not come up within 10s (see {log_path})", file=sys.stderr)
+    return 1
+
+
+def cmd_daemon(args):
+    run_path = _run_path(args)
+    sock = args.socket or consts.socket_path(run_path)
+    if args.daemon_cmd == "serve":
+        from kukeon_tpu.runtime.daemon import DaemonServer
+
+        interval = float(os.environ.get("KUKEOND_RECONCILE_INTERVAL",
+                                        consts.DEFAULT_RECONCILE_INTERVAL_S))
+        DaemonServer(run_path, sock, reconcile_interval_s=interval).serve()
+        return 0
+    if args.daemon_cmd == "start":
+        return _daemon_start(run_path, args.socket)
+    if args.daemon_cmd in ("stop", "kill"):
+        pid_file = os.path.join(run_path, "kukeond.pid")
+        try:
+            pid = int(open(pid_file).read().strip())
+        except (OSError, ValueError):
+            print("daemon not running (no pid file)")
+            return 0
+        sig = signal.SIGTERM if args.daemon_cmd == "stop" else signal.SIGKILL
+        try:
+            os.kill(pid, sig)
+        except ProcessLookupError:
+            print("daemon not running (stale pid)")
+            return 0
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                os.kill(pid, 0)
+                time.sleep(0.1)
+            except ProcessLookupError:
+                break
+        else:
+            os.kill(pid, signal.SIGKILL)
+        print("daemon stopped")
+        return 0
+    if args.daemon_cmd == "status":
+        try:
+            _print(UnixClient(sock).call("Status"), args.json)
+            return 0
+        except KukeonError as e:
+            print(f"daemon unreachable: {e}", file=sys.stderr)
+            return 1
+    if args.daemon_cmd == "logs":
+        log_path = os.path.join(run_path, "kukeond.log")
+        return _tail(log_path, follow=args.follow)
+    if args.daemon_cmd == "restart":
+        args.daemon_cmd = "stop"
+        cmd_daemon(args)
+        return _daemon_start(run_path, args.socket)
+    print(f"unknown daemon subcommand {args.daemon_cmd!r}", file=sys.stderr)
+    return 2
+
+
+def cmd_apply(args):
+    blob = sys.stdin.read() if args.file == "-" else open(args.file).read()
+    c = _client(args)
+    results = c.call("ApplyDocuments", yaml=blob, team=args.team, prune=args.prune)
+    for r in results:
+        print(f"{r['kind'].lower()}/{r['name']} ({r['scope']}): {r['action']}")
+    return 0
+
+
+def cmd_delete(args):
+    c = _client(args)
+    if args.file:
+        blob = sys.stdin.read() if args.file == "-" else open(args.file).read()
+        for r in c.call("DeleteDocuments", yaml=blob):
+            print(f"{r['kind'].lower()}/{r['name']} ({r['scope']}): {r['action']}")
+        return 0
+    kind, name = args.kind, args.name
+    s = _scope(args)
+    if kind in ("cell", "cells"):
+        c.call("DeleteCell", **s, name=name, force=args.force)
+    elif kind in ("realm", "realms"):
+        c.call("DeleteRealm", name=name, purge=args.force)
+    elif kind in ("space", "spaces"):
+        c.call("DeleteSpace", realm=s["realm"], name=name, purge=args.force)
+    elif kind in ("stack", "stacks"):
+        c.call("DeleteStack", realm=s["realm"], space=s["space"], name=name, purge=args.force)
+    elif kind in ("secret", "secrets"):
+        c.call("DeleteSecret", realm=s["realm"], space=args.space, stack=args.stack, name=name)
+    elif kind in ("blueprint", "blueprints", "cellblueprint"):
+        c.call("DeleteBlueprint", realm=s["realm"], space=args.space, stack=args.stack, name=name)
+    elif kind in ("config", "configs", "cellconfig"):
+        c.call("DeleteConfig", realm=s["realm"], space=args.space, stack=args.stack, name=name)
+    elif kind in ("volume", "volumes"):
+        c.call("DeleteVolume", realm=s["realm"], space=args.space, stack=args.stack, name=name)
+    else:
+        print(f"unknown kind {kind!r}", file=sys.stderr)
+        return 2
+    print(f"{kind}/{name}: deleted")
+    return 0
+
+
+def cmd_get(args):
+    c = _client(args)
+    s = _scope(args)
+    kind = args.kind
+    if kind in ("realms", "realm"):
+        if args.name:
+            _print(c.call("GetRealm", name=args.name), args.json)
+        else:
+            for r in c.call("ListRealms"):
+                print(r)
+    elif kind in ("spaces", "space"):
+        if args.name:
+            _print(c.call("GetSpace", realm=s["realm"], name=args.name), args.json)
+        else:
+            for x in c.call("ListSpaces", realm=s["realm"]):
+                print(x)
+    elif kind in ("stacks", "stack"):
+        if args.name:
+            _print(c.call("GetStack", realm=s["realm"], space=s["space"], name=args.name), args.json)
+        else:
+            for x in c.call("ListStacks", realm=s["realm"], space=s["space"]):
+                print(x)
+    elif kind in ("cells", "cell"):
+        if args.name:
+            _print(c.call("GetCell", **s, name=args.name), args.json)
+        else:
+            rows = c.call("ListCells", realm=s["realm"],
+                          space=getattr(args, "space", None),
+                          stack=getattr(args, "stack", None))
+            if args.json:
+                _print(rows, True)
+            else:
+                fmt = "{:<24} {:<10} {:<28} {:<9} {}"
+                print(fmt.format("NAME", "PHASE", "SCOPE", "CHIPS", "CONTAINERS"))
+                for r in rows:
+                    scope = f"{r['realm']}/{r['space']}/{r['stack']}"
+                    chips = ",".join(map(str, r["status"].get("tpuChips", []))) or "-"
+                    conts = ",".join(
+                        f"{cs['name']}:{cs['state']}" for cs in r["status"]["containers"]
+                    )
+                    print(fmt.format(r["name"], r["status"]["phase"], scope, chips, conts))
+    elif kind in ("secrets", "secret"):
+        for x in c.call("ListSecrets", realm=s["realm"], space=args.space, stack=args.stack):
+            print(x)
+    elif kind in ("blueprints", "blueprint", "cellblueprints"):
+        for x in c.call("ListBlueprints", realm=s["realm"], space=args.space, stack=args.stack):
+            print(x)
+    elif kind in ("configs", "config", "cellconfigs"):
+        for x in c.call("ListConfigs", realm=s["realm"], space=args.space, stack=args.stack):
+            print(x)
+    elif kind in ("volumes", "volume"):
+        for x in c.call("ListVolumes", realm=s["realm"], space=args.space, stack=args.stack):
+            print(x)
+    else:
+        print(f"unknown kind {kind!r}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def cmd_lifecycle(args):
+    c = _client(args)
+    s = _scope(args)
+    out = c.call(args.verb.capitalize() + "Cell", **s, name=args.name)
+    print(f"cell/{args.name}: {out['status']['phase']}")
+    return 0
+
+
+def cmd_run(args):
+    """Create-or-attach state machine (reference: cmd/kuke/run)."""
+    c = _client(args)
+    s = _scope(args)
+    name = args.name
+
+    if args.from_blueprint:
+        values = dict(kv.split("=", 1) for kv in (args.param or []))
+        rec = c.call("RunBlueprint", realm=s["realm"], space=s["space"], stack=s["stack"],
+                     blueprint=args.from_blueprint, values=values)
+        name = rec["name"]
+    elif args.from_config:
+        rec = c.call("MaterializeConfig", realm=s["realm"], space=s["space"],
+                     stack=s["stack"], name=args.from_config)
+        name = rec["name"]
+    elif args.file:
+        blob = sys.stdin.read() if args.file == "-" else open(args.file).read()
+        docs = list(yaml.safe_load_all(blob))
+        cells = [d for d in docs if d and d.get("kind") == "Cell"]
+        if len(cells) != 1:
+            print("error: kuke run -f needs exactly one Cell document", file=sys.stderr)
+            return 2
+        doc = cells[0]
+        if args.rm:
+            doc.setdefault("spec", {})["autoDelete"] = True
+        name = doc.get("metadata", {}).get("name")
+        md = doc.get("metadata", {})
+        s = {"realm": md.get("realm") or s["realm"], "space": md.get("space") or s["space"],
+             "stack": md.get("stack") or s["stack"]}
+        try:
+            existing = c.call("GetCell", **s, name=name)
+        except KukeonError:
+            existing = None
+        if existing is None:
+            rec = c.call("CreateCell", doc=doc)
+        elif existing["status"]["phase"] in ("stopped", "failed"):
+            rec = c.call("StartCell", **s, name=name)
+        else:
+            rec = existing
+    elif name:
+        try:
+            rec = c.call("GetCell", **s, name=name)
+            if rec["status"]["phase"] in ("stopped", "failed"):
+                rec = c.call("StartCell", **s, name=name)
+        except KukeonError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    else:
+        print("error: kuke run needs a cell name, -f, -b, or -c", file=sys.stderr)
+        return 2
+
+    print(f"cell/{name}: {rec['status']['phase']}")
+    if args.detach:
+        return 0
+    return _attach(c, s, name, args.container)
+
+
+def cmd_attach(args):
+    c = _client(args)
+    s = _scope(args)
+    return _attach(c, s, args.name, args.container)
+
+
+def _attach(c, s, name, container) -> int:
+    from kukeon_tpu.runtime.attach import run_attach
+
+    info = c.call("AttachContainer", realm=s["realm"], space=s["space"],
+                  stack=s["stack"], cell=name, container=container)
+    return run_attach(info["socketPath"])
+
+
+def cmd_log(args):
+    c = _client(args)
+    s = _scope(args)
+    info = c.call("Log", realm=s["realm"], space=s["space"], stack=s["stack"],
+                  cell=args.name, container=args.container)
+    return _tail(info["path"], follow=args.follow)
+
+
+def _tail(path: str, follow: bool = False) -> int:
+    if not os.path.exists(path):
+        print(f"(no log yet at {path})", file=sys.stderr)
+        if not follow:
+            return 1
+    pos = 0
+    try:
+        while True:
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    f.seek(pos)
+                    chunk = f.read()
+                    pos = f.tell()
+                if chunk:
+                    sys.stdout.buffer.write(chunk)
+                    sys.stdout.flush()
+            if not follow:
+                return 0
+            time.sleep(1.0)   # reference: 1s poll (log.go:63-84)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_status(args):
+    try:
+        c = _client(args)
+        t0 = time.monotonic()
+        ping = c.call("Ping")
+        rtt_ms = (time.monotonic() - t0) * 1000
+        status = c.call("Status")
+        status["daemon"] = {"pid": ping["pid"], "rttMs": round(rtt_ms, 2),
+                            "uptimeSeconds": round(ping["uptimeSeconds"], 1)}
+        _print(status, args.json)
+        return 0
+    except KukeonError as e:
+        print(f"daemon: unreachable ({e})", file=sys.stderr)
+        return 1
+
+
+def cmd_doctor(args):
+    """Host pre-flight checks (reference: kuke doctor / cgroupcheck)."""
+    from kukeon_tpu.runtime.cgroups import CgroupManager
+    from kukeon_tpu.runtime.devices import discover_chips
+
+    checks = []
+    cg = CgroupManager()
+    checks.append(("cgroup-v2", "ok" if cg.available() else "unavailable (limits degrade)"))
+    chips = discover_chips()
+    checks.append(("tpu-chips", f"{len(chips)} visible ({chips})" if chips else "none visible"))
+    bin_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bin")
+    for b in ("kukepause", "kukeshim", "kuketty"):
+        ok = os.access(os.path.join(bin_dir, b), os.X_OK)
+        checks.append((f"native/{b}", "ok" if ok else "MISSING (run `make -C native`)"))
+    run_path = _run_path(args)
+    checks.append(("run-path", run_path + (" (exists)" if os.path.isdir(run_path) else " (not initialized — run `kuke init`)")))
+    for name, result in checks:
+        print(f"{name:<18} {result}")
+    return 0
+
+
+def cmd_purge(args):
+    c = _client(args)
+    s = _scope(args)
+    if args.kind in ("realm", "realms"):
+        c.call("DeleteRealm", name=args.name, purge=True)
+    elif args.kind in ("space", "spaces"):
+        c.call("DeleteSpace", realm=s["realm"], name=args.name, purge=True)
+    elif args.kind in ("stack", "stacks"):
+        c.call("DeleteStack", realm=s["realm"], space=s["space"], name=args.name, purge=True)
+    else:
+        print(f"purge supports realm|space|stack, not {args.kind!r}", file=sys.stderr)
+        return 2
+    print(f"{args.kind}/{args.name}: purged")
+    return 0
+
+
+def cmd_refresh(args):
+    c = _client(args)
+    counts = c.call("ReconcileNow")
+    _print(counts, args.json)
+    return 0
+
+
+def cmd_uninstall(args):
+    run_path = _run_path(args)
+    if not args.yes:
+        print(f"would remove {run_path}; pass --yes to confirm", file=sys.stderr)
+        return 2
+    try:
+        args.daemon_cmd = "stop"
+        args.socket = None
+        cmd_daemon(args)
+    except Exception:  # noqa: BLE001
+        pass
+    import shutil
+
+    shutil.rmtree(run_path, ignore_errors=True)
+    print(f"removed {run_path}")
+    return 0
+
+
+# --- parser ------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kuke", description="kukeon-tpu: TPU-native agent runtime")
+    p.add_argument("--run-path", default=None, help="metadata root (env KUKEON_RUN_PATH)")
+    p.add_argument("--socket", default=None, help="daemon socket (env KUKEOND_SOCKET)")
+    p.add_argument("--no-daemon", action="store_true", help="run the controller in-process")
+    p.add_argument("--json", action="store_true", help="JSON output")
+
+    # Global flags are accepted after the verb too (SUPPRESS keeps a
+    # flag-after-verb from clobbering a flag-before-verb with its default).
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--run-path", default=argparse.SUPPRESS)
+    common.add_argument("--socket", default=argparse.SUPPRESS)
+    common.add_argument("--no-daemon", action="store_true", default=argparse.SUPPRESS)
+    common.add_argument("--json", action="store_true", default=argparse.SUPPRESS)
+
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def sub_add(name, **kw):
+        return sub.add_parser(name, parents=[common], **kw)
+
+    sub_add("version")
+    sp = sub_add("init")
+    sp.add_argument("--no-daemon-start", action="store_true")
+
+    sp = sub_add("daemon")
+    sp.add_argument("daemon_cmd", choices=["serve", "start", "stop", "kill",
+                                           "restart", "status", "logs"])
+    sp.add_argument("-f", "--follow", action="store_true")
+
+    sp = sub_add("apply")
+    sp.add_argument("-f", "--file", required=True)
+    sp.add_argument("--team", default=None)
+    sp.add_argument("--prune", action="store_true")
+
+    sp = sub_add("delete")
+    sp.add_argument("kind", nargs="?", default=None)
+    sp.add_argument("name", nargs="?", default=None)
+    sp.add_argument("-f", "--file", default=None)
+    sp.add_argument("--force", action="store_true")
+    _scope_args(sp)
+
+    sp = sub_add("get")
+    sp.add_argument("kind")
+    sp.add_argument("name", nargs="?", default=None)
+    _scope_args(sp)
+
+    for verb in ("start", "stop", "kill"):
+        sp = sub_add(verb)
+        sp.add_argument("name")
+        sp.set_defaults(verb=verb)
+        _scope_args(sp)
+
+    sp = sub_add("run")
+    sp.add_argument("name", nargs="?", default=None)
+    sp.add_argument("-f", "--file", default=None)
+    sp.add_argument("-b", "--from-blueprint", default=None)
+    sp.add_argument("-c", "--from-config", default=None)
+    sp.add_argument("-p", "--param", action="append", help="KEY=VALUE blueprint params")
+    sp.add_argument("--rm", action="store_true", help="autoDelete on exit")
+    sp.add_argument("-d", "--detach", action="store_true")
+    sp.add_argument("--container", default=None)
+    _scope_args(sp)
+
+    sp = sub_add("attach")
+    sp.add_argument("name")
+    sp.add_argument("--container", default=None)
+    _scope_args(sp)
+
+    sp = sub_add("log")
+    sp.add_argument("name")
+    sp.add_argument("--container", default=None)
+    sp.add_argument("-f", "--follow", action="store_true")
+    _scope_args(sp)
+
+    sub_add("status")
+    sub_add("doctor")
+    sub_add("refresh")
+
+    sp = sub_add("purge")
+    sp.add_argument("kind")
+    sp.add_argument("name")
+    _scope_args(sp)
+
+    sp = sub_add("uninstall")
+    sp.add_argument("--yes", action="store_true")
+    return p
+
+
+def _scope_args(sp):
+    sp.add_argument("--realm", default=None)
+    sp.add_argument("--space", default=None)
+    sp.add_argument("--stack", default=None)
+
+
+HANDLERS = {
+    "version": cmd_version,
+    "init": cmd_init,
+    "daemon": cmd_daemon,
+    "apply": cmd_apply,
+    "delete": cmd_delete,
+    "get": cmd_get,
+    "start": cmd_lifecycle,
+    "stop": cmd_lifecycle,
+    "kill": cmd_lifecycle,
+    "run": cmd_run,
+    "attach": cmd_attach,
+    "log": cmd_log,
+    "status": cmd_status,
+    "doctor": cmd_doctor,
+    "refresh": cmd_refresh,
+    "purge": cmd_purge,
+    "uninstall": cmd_uninstall,
+}
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return HANDLERS[args.cmd](args)
+    except KukeonError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

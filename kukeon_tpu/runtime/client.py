@@ -1,0 +1,131 @@
+"""Client SDK: the transport-agnostic Client + UnixClient + in-process client.
+
+Reference: pkg/api/kukeonv1 (client.go:32, rpcclient.go:36-80, dial.go:37-50)
+and internal/client/local (the "promotion" path: read/maintenance verbs can
+run the controller in-process when the daemon isn't required).
+
+``dial()`` picks the transport by scheme: ``unix://`` today; ``ssh://`` is
+reserved for multi-host TPU-VM workers (same reservation as the reference).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+
+from kukeon_tpu.runtime.errors import KukeonError, NotSupported, Unavailable, from_code
+
+DIAL_TIMEOUT_S = 5.0   # reference: rpcclient.go:34
+
+
+class UnixClient:
+    """Persistent-connection JSON-RPC client (lazy dial, thread-safe)."""
+
+    def __init__(self, socket_path: str, timeout_s: float = DIAL_TIMEOUT_S):
+        self.socket_path = socket_path
+        self.timeout_s = timeout_s
+        self._sock: socket.socket | None = None
+        self._file = None
+        self._id = 0
+        self._lock = threading.Lock()
+
+    # --- transport ---------------------------------------------------------
+
+    def _ensure_conn(self):
+        if self._sock is not None:
+            return
+        s = socket.socket(socket.AF_UNIX)
+        s.settimeout(self.timeout_s)
+        try:
+            s.connect(self.socket_path)
+        except OSError as e:
+            raise Unavailable(
+                f"cannot reach kukeond at {self.socket_path}: {e} "
+                f"(is the daemon running? try `kuke daemon start`)"
+            ) from None
+        s.settimeout(None)
+        self._sock = s
+        self._file = s.makefile("rwb")
+
+    def close(self):
+        with self._lock:
+            if self._file:
+                self._file.close()
+                self._file = None
+            if self._sock:
+                self._sock.close()
+                self._sock = None
+
+    def call(self, method: str, **params):
+        with self._lock:
+            self._ensure_conn()
+            self._id += 1
+            req = {"id": self._id, "method": method, "params": params}
+            try:
+                self._file.write((json.dumps(req) + "\n").encode())
+                self._file.flush()
+                line = self._file.readline()
+            except OSError as e:
+                self.close()
+                raise Unavailable(f"daemon connection lost: {e}") from None
+            if not line:
+                self.close()
+                raise Unavailable("daemon closed the connection")
+        resp = json.loads(line)
+        if "error" in resp and resp["error"]:
+            err = resp["error"]
+            raise from_code(err.get("code", "internal"), err.get("message", ""))
+        return resp.get("result")
+
+    def __getattr__(self, name: str):
+        if name.startswith("_") or not name[0].isupper():
+            raise AttributeError(name)
+
+        def method(**params):
+            return self.call(name, **params)
+
+        return method
+
+
+class LocalClient:
+    """In-process client running the controller directly — the promotion
+    path (reference: internal/client/local). Same call surface as UnixClient."""
+
+    def __init__(self, run_path: str):
+        from kukeon_tpu.runtime.daemon import RPCService, build_controller
+
+        self.ctl = build_controller(run_path)
+        self.ctl.bootstrap()
+        self.service = RPCService(self.ctl)
+
+    def call(self, method: str, **params):
+        fn = getattr(self.service, method, None)
+        if fn is None or method.startswith("_"):
+            raise KukeonError(f"unknown method {method!r}")
+        return fn(**params)
+
+    def close(self):
+        pass
+
+    def __getattr__(self, name: str):
+        if name.startswith("_") or not name[0].isupper():
+            raise AttributeError(name)
+
+        def method(**params):
+            return self.call(name, **params)
+
+        return method
+
+
+def dial(target: str):
+    """unix://<path> today; ssh://host reserved for multi-host slices."""
+    if target.startswith("unix://"):
+        return UnixClient(target[len("unix://") :])
+    if target.startswith("ssh://"):
+        raise NotSupported(
+            "ssh:// transport (multi-host TPU workers) is reserved, not yet implemented"
+        )
+    if target.startswith("/"):
+        return UnixClient(target)
+    raise NotSupported(f"unsupported transport in {target!r}")

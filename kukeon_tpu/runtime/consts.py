@@ -1,0 +1,71 @@
+"""Path/label/name constants (reference: internal/consts/consts.go).
+
+The on-disk layout mirrors the resource hierarchy:
+
+  <run_path>/
+    instance.json                     # instance pinning
+    realms/<realm>/realm.json
+    realms/<realm>/secrets/<name>.json
+    realms/<realm>/blueprints/<name>.json
+    realms/<realm>/configs/<name>.json
+    realms/<realm>/volumes/<name>/volume.json + data/
+    realms/<realm>/spaces/<space>/space.json
+    .../stacks/<stack>/stack.json
+    .../cells/<cell>/cell.json
+    .../cells/<cell>/containers/<name>/   # logs, tty socket, pidfile
+  kukeond.sock                        # daemon socket (next to run path by default)
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_RUN_PATH = "/opt/kukeon-tpu"
+DEFAULT_SOCKET_NAME = "kukeond.sock"
+DEFAULT_REALM = "default"
+SYSTEM_REALM = "kuke-system"
+DEFAULT_SPACE = "default"
+DEFAULT_STACK = "default"
+
+REALMS_DIR = "realms"
+SPACES_DIR = "spaces"
+STACKS_DIR = "stacks"
+CELLS_DIR = "cells"
+CONTAINERS_DIR = "containers"
+SECRETS_DIR = "secrets"
+BLUEPRINTS_DIR = "blueprints"
+CONFIGS_DIR = "configs"
+VOLUMES_DIR = "volumes"
+
+INSTANCE_FILE = "instance.json"
+
+# Label keys (team-prune and provenance; reference: *.kukeon.io labels).
+LABEL_TEAM = "kukeon.io/team"
+LABEL_PROVENANCE_CONFIG = "kukeon.io/config"
+LABEL_PROVENANCE_BLUEPRINT = "kukeon.io/blueprint"
+
+# TTY / attach file basenames inside a container dir.
+TTY_SOCKET = "tty.sock"
+CAPTURE_FILE = "capture.log"
+SHIM_LOG = "container.log"
+PID_FILE = "pid"
+SETUP_STATUS_FILE = "setup-status.json"
+
+# Default subnet pool for space networks (reference: KUKEON_POD_SUBNET_CIDR).
+DEFAULT_SUBNET_POOL = "10.88.0.0/16"
+
+# Reconcile defaults (reference: KUKEOND_RECONCILE_INTERVAL = 30s).
+DEFAULT_RECONCILE_INTERVAL_S = 30.0
+DEFAULT_STOP_GRACE_S = 10.0
+
+# Disk-pressure thresholds (reference: KUKEOND_DISK_PRESSURE_*).
+DISK_PRESSURE_WARN_PCT = 85.0
+DISK_PRESSURE_BLOCK_PCT = 95.0
+
+
+def socket_path(run_path: str) -> str:
+    return os.path.join(run_path, DEFAULT_SOCKET_NAME)
+
+
+def env_run_path() -> str:
+    return os.environ.get("KUKEON_RUN_PATH", DEFAULT_RUN_PATH)

@@ -1,0 +1,372 @@
+"""kukeond: the unix-socket JSON-RPC daemon + reconcile loops.
+
+Reference: internal/daemon (server.go:42-260, rpcservice.go:30-470). The
+server owns the listener (socket mode 0660), a PID file, the RPC verb
+facade, an eager startup reconcile pass, and the periodic reconcile ticker
+(default 30s — KUKEOND_RECONCILE_INTERVAL).
+
+Protocol: newline-delimited JSON frames on a persistent connection:
+  -> {"id": 1, "method": "CreateCell", "params": {...}}
+  <- {"id": 1, "result": {...}} | {"id": 1, "error": {"code": "...", "message": "..."}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import socketserver
+import threading
+import time
+import traceback
+
+from kukeon_tpu.runtime import consts
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.apply import parser
+from kukeon_tpu.runtime.cells import ProcessBackend
+from kukeon_tpu.runtime.cgroups import CgroupManager
+from kukeon_tpu.runtime.controller import Controller
+from kukeon_tpu.runtime.devices import TPUDeviceManager
+from kukeon_tpu.runtime.errors import InvalidArgument, KukeonError, NotFound
+from kukeon_tpu.runtime.metadata import MetadataStore
+from kukeon_tpu.runtime.runner import Runner
+from kukeon_tpu.runtime.store import ResourceStore
+
+PROTOCOL_VERSION = "v1"
+
+
+def build_controller(run_path: str) -> Controller:
+    ms = MetadataStore(run_path)
+    store = ResourceStore(ms)
+    cg = CgroupManager()
+    runner = Runner(
+        store,
+        ProcessBackend(),
+        cgroups=cg if cg.available() else None,
+        devices=TPUDeviceManager(ms),
+    )
+    return Controller(store, runner)
+
+
+class RPCService:
+    """Verb facade mapping RPC methods onto the controller
+    (reference: KukeonV1Service, rpcservice.go:30-470)."""
+
+    def __init__(self, ctl: Controller, server: "DaemonServer | None" = None):
+        self.ctl = ctl
+        self.server = server
+        self.started_at = time.time()
+
+    # Every public method is an RPC endpoint.
+
+    def Ping(self) -> dict:
+        return {
+            "protocol": PROTOCOL_VERSION,
+            "pid": os.getpid(),
+            "uptimeSeconds": time.time() - self.started_at,
+        }
+
+    def ApplyDocuments(self, yaml: str, team: str | None = None,
+                       prune: bool = False) -> list[dict]:
+        results = self.ctl.apply_documents(yaml, team=team, prune=prune)
+        return [vars(r) for r in results]
+
+    def DeleteDocuments(self, yaml: str) -> list[dict]:
+        return [vars(r) for r in self.ctl.delete_documents(yaml)]
+
+    # Scopes.
+    def CreateRealm(self, name: str) -> dict:
+        self.ctl.create_realm(name)
+        return self.ctl.get_realm(name)
+
+    def CreateSpace(self, realm: str, name: str) -> dict:
+        self.ctl.create_space(realm, name)
+        return self.ctl.get_space(realm or consts.DEFAULT_REALM, name)
+
+    def CreateStack(self, realm: str, space: str, name: str) -> dict:
+        self.ctl.create_stack(realm, space, name)
+        return self.ctl.get_stack(realm or consts.DEFAULT_REALM,
+                                  space or consts.DEFAULT_SPACE, name)
+
+    def GetRealm(self, name: str) -> dict:
+        return self.ctl.get_realm(name)
+
+    def GetSpace(self, realm: str, name: str) -> dict:
+        return self.ctl.get_space(realm, name)
+
+    def GetStack(self, realm: str, space: str, name: str) -> dict:
+        return self.ctl.get_stack(realm, space, name)
+
+    def ListRealms(self) -> list[str]:
+        return self.ctl.list_realms()
+
+    def ListSpaces(self, realm: str) -> list[str]:
+        return self.ctl.list_spaces(realm)
+
+    def ListStacks(self, realm: str, space: str) -> list[str]:
+        return self.ctl.list_stacks(realm, space)
+
+    def DeleteRealm(self, name: str, purge: bool = False) -> None:
+        self.ctl.delete_realm(name, purge)
+
+    def DeleteSpace(self, realm: str, name: str, purge: bool = False) -> None:
+        self.ctl.delete_space(realm, name, purge)
+
+    def DeleteStack(self, realm: str, space: str, name: str, purge: bool = False) -> None:
+        self.ctl.delete_stack(realm, space, name, purge)
+
+    # Cells.
+    def CreateCell(self, doc: dict, start: bool = True) -> dict:
+        parsed = parser.parse_document(doc, "CreateCell.doc")
+        if parsed.kind != t.KIND_CELL:
+            raise InvalidArgument("CreateCell expects a Cell document")
+        return self.ctl.create_cell(parsed, start=start)
+
+    def GetCell(self, realm: str, space: str, stack: str, name: str) -> dict:
+        return self.ctl.get_cell(realm, space, stack, name)
+
+    def ListCells(self, realm: str, space: str | None = None,
+                  stack: str | None = None) -> list[dict]:
+        return self.ctl.list_cells(realm, space, stack)
+
+    def StartCell(self, realm: str, space: str, stack: str, name: str) -> dict:
+        return self.ctl.start_cell(realm, space, stack, name)
+
+    def StopCell(self, realm: str, space: str, stack: str, name: str) -> dict:
+        return self.ctl.stop_cell(realm, space, stack, name)
+
+    def KillCell(self, realm: str, space: str, stack: str, name: str) -> dict:
+        return self.ctl.kill_cell(realm, space, stack, name)
+
+    def DeleteCell(self, realm: str, space: str, stack: str, name: str,
+                   force: bool = False) -> None:
+        self.ctl.delete_cell(realm, space, stack, name, force)
+
+    # Secrets / blueprints / configs / volumes.
+    def PutSecret(self, doc: dict) -> None:
+        self.ctl.put_secret(parser.parse_document(doc, "PutSecret.doc"))
+
+    def ListSecrets(self, realm: str, space: str | None = None,
+                    stack: str | None = None) -> list[str]:
+        return self.ctl.get_secret_names(realm, space, stack)
+
+    def DeleteSecret(self, realm: str, space: str | None, stack: str | None,
+                     name: str) -> None:
+        self.ctl.delete_secret(realm, space, stack, name)
+
+    def PutBlueprint(self, doc: dict) -> None:
+        self.ctl.put_blueprint(parser.parse_document(doc, "PutBlueprint.doc"))
+
+    def ListBlueprints(self, realm: str, space: str | None = None,
+                       stack: str | None = None) -> list[str]:
+        return self.ctl.list_blueprints(realm, space, stack)
+
+    def DeleteBlueprint(self, realm: str, space: str | None, stack: str | None,
+                        name: str) -> None:
+        self.ctl.delete_blueprint(realm, space, stack, name)
+
+    def PutConfig(self, doc: dict) -> None:
+        self.ctl.put_config(parser.parse_document(doc, "PutConfig.doc"))
+
+    def ListConfigs(self, realm: str, space: str | None = None,
+                    stack: str | None = None) -> list[str]:
+        return self.ctl.list_configs(realm, space, stack)
+
+    def DeleteConfig(self, realm: str, space: str | None, stack: str | None,
+                     name: str) -> None:
+        self.ctl.delete_config(realm, space, stack, name)
+
+    def PutVolume(self, doc: dict) -> None:
+        self.ctl.put_volume(parser.parse_document(doc, "PutVolume.doc"))
+
+    def ListVolumes(self, realm: str, space: str | None = None,
+                    stack: str | None = None) -> list[str]:
+        return self.ctl.list_volumes(realm, space, stack)
+
+    def DeleteVolume(self, realm: str, space: str | None, stack: str | None,
+                     name: str) -> None:
+        self.ctl.delete_volume(realm, space, stack, name)
+
+    def RunBlueprint(self, realm: str, space: str | None, stack: str | None,
+                     blueprint: str, values: dict | None = None) -> dict:
+        return self.ctl.run_blueprint(realm, space, stack, blueprint, values or {})
+
+    def MaterializeConfig(self, realm: str, space: str | None, stack: str | None,
+                          name: str) -> dict:
+        return self.ctl.materialize_config(realm, space, stack, name)
+
+    # Attach / logs: the daemon returns host paths; bytes flow directly
+    # between the client and kuketty (reference design, attach.go:17-23).
+    def AttachContainer(self, realm: str, space: str, stack: str, cell: str,
+                        container: str | None = None) -> dict:
+        rec_json = self.ctl.get_cell(realm, space, stack, cell)
+        rec_containers = rec_json["status"]["containers"]
+        if container is None:
+            attachables = [
+                c.name for c in self._cell_specs(realm, space, stack, cell)
+                if c.attachable
+            ]
+            if not attachables:
+                raise InvalidArgument(f"cell {cell!r} has no attachable container")
+            container = attachables[0]
+        st = next((c for c in rec_containers if c["name"] == container), None)
+        if st is None:
+            raise NotFound(f"container {container!r} not found in cell {cell!r}")
+        if st["state"] != "running":
+            raise InvalidArgument(f"container {container!r} is {st['state']}, not running")
+        cdir = self.ctl.store.container_dir(realm, space, stack, cell, container)
+        return {
+            "socketPath": os.path.join(cdir, consts.TTY_SOCKET),
+            "capturePath": os.path.join(cdir, consts.CAPTURE_FILE),
+        }
+
+    def Log(self, realm: str, space: str, stack: str, cell: str,
+            container: str | None = None) -> dict:
+        specs = self._cell_specs(realm, space, stack, cell)
+        if container is None:
+            if not specs:
+                raise NotFound(f"cell {cell!r} has no containers")
+            container = specs[0].name
+        spec = next((c for c in specs if c.name == container), None)
+        if spec is None:
+            raise NotFound(f"container {container!r} not found in cell {cell!r}")
+        cdir = self.ctl.store.container_dir(realm, space, stack, cell, container)
+        # Exactly one of capture (attachable) or shim log (reference:
+        # kukeonv1/types.go:725-746).
+        if spec.attachable:
+            return {"path": os.path.join(cdir, consts.CAPTURE_FILE), "kind": "capture"}
+        return {"path": os.path.join(cdir, consts.SHIM_LOG), "kind": "log"}
+
+    def _cell_specs(self, realm, space, stack, cell) -> list[t.ContainerSpec]:
+        rec = self.ctl.store.read_cell(realm, space, stack, cell)
+        return self.ctl.runner.cell_containers(rec)
+
+    def ReconcileNow(self) -> dict:
+        return self.ctl.reconcile_cells()
+
+    def Status(self) -> dict:
+        ms = self.ctl.store.ms
+        st = os.statvfs(ms.root)
+        realms = self.ctl.list_realms()
+        n_cells = sum(
+            len(self.ctl.list_cells(r)) for r in realms
+        )
+        dm = self.ctl.runner.devices
+        return {
+            "pid": os.getpid(),
+            "runPath": ms.root,
+            "realms": realms,
+            "cells": n_cells,
+            "diskUsedPct": round(100.0 * (1 - st.f_bavail / max(st.f_blocks, 1)), 1),
+            "tpuChips": {"total": len(dm.chips), "free": len(dm.free_chips()),
+                         "allocations": {str(k): v for k, v in dm.allocated().items()}},
+        }
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        service: RPCService = self.server.rpc_service  # type: ignore[attr-defined]
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            req: dict | None = None
+            try:
+                req = json.loads(line)
+                rid = req.get("id")
+                method = req.get("method", "")
+                params = req.get("params") or {}
+                if method.startswith("_") or not hasattr(service, method):
+                    raise InvalidArgument(f"unknown method {method!r}")
+                result = getattr(service, method)(**params)
+                resp = {"id": rid, "result": result}
+            except KukeonError as e:
+                resp = {"id": req.get("id") if isinstance(req, dict) else None,
+                        "error": {"code": e.code, "message": str(e)}}
+            except Exception as e:  # noqa: BLE001 — daemon must not die on a bad request
+                traceback.print_exc()
+                resp = {"id": req.get("id") if isinstance(req, dict) else None,
+                        "error": {"code": "internal", "message": f"{type(e).__name__}: {e}"}}
+            try:
+                self.wfile.write((json.dumps(resp) + "\n").encode())
+                self.wfile.flush()
+            except BrokenPipeError:
+                return
+
+
+class _ThreadingUnixServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class DaemonServer:
+    def __init__(self, run_path: str, socket_path: str | None = None,
+                 reconcile_interval_s: float = consts.DEFAULT_RECONCILE_INTERVAL_S):
+        self.run_path = run_path
+        self.socket_path = socket_path or consts.socket_path(run_path)
+        self.reconcile_interval_s = reconcile_interval_s
+        self.ctl = build_controller(run_path)
+        self._shutdown = threading.Event()
+        self._server: _ThreadingUnixServer | None = None
+
+    def serve(self) -> None:
+        os.makedirs(self.run_path, exist_ok=True)
+        self.ctl.bootstrap()
+        # Stale socket from a previous daemon: unlink after a probe.
+        if os.path.exists(self.socket_path):
+            if self._socket_alive():
+                raise KukeonError(f"daemon already listening on {self.socket_path}")
+            os.unlink(self.socket_path)
+
+        pid_file = os.path.join(self.run_path, "kukeond.pid")
+        with open(pid_file, "w") as f:
+            f.write(str(os.getpid()))
+
+        self._server = _ThreadingUnixServer(self.socket_path, _Handler)
+        self._server.rpc_service = RPCService(self.ctl, self)  # type: ignore[attr-defined]
+        os.chmod(self.socket_path, 0o660)
+
+        # Eager reconcile pass: a host restart converges immediately
+        # (reference: server.go:226-244).
+        self.ctl.reconcile_cells()
+        ticker = threading.Thread(target=self._reconcile_loop, daemon=True,
+                                  name="reconcile")
+        ticker.start()
+
+        def _stop(signum, frame):
+            del signum, frame
+            self.shutdown()
+
+        signal.signal(signal.SIGTERM, _stop)
+        signal.signal(signal.SIGINT, _stop)
+        try:
+            self._server.serve_forever(poll_interval=0.2)
+        finally:
+            self._shutdown.set()
+            with open(pid_file, "w") as f:
+                f.write("")
+            if os.path.exists(self.socket_path):
+                os.unlink(self.socket_path)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self._server:
+            threading.Thread(target=self._server.shutdown, daemon=True).start()
+
+    def _reconcile_loop(self) -> None:
+        while not self._shutdown.wait(self.reconcile_interval_s):
+            try:
+                self.ctl.reconcile_cells()
+            except Exception:  # noqa: BLE001 — ticker must survive
+                traceback.print_exc()
+
+    def _socket_alive(self) -> bool:
+        try:
+            s = socket.socket(socket.AF_UNIX)
+            s.settimeout(1.0)
+            s.connect(self.socket_path)
+            s.close()
+            return True
+        except OSError:
+            return False

@@ -1,0 +1,114 @@
+"""TPU device manager: chip discovery, per-cell affinity, visibility env.
+
+The first TPU-native piece (SURVEY.md section 7 step 5; BASELINE.json north
+star: "internal/ctr grows a libtpu device manager"). Chips are a schedulable
+resource like the reference's memory limits: the runner asks for N chips at
+cell start, the manager hands out concrete chip ids, persists the allocation
+in the metadata store, and produces the env that makes libtpu/JAX see ONLY
+those chips (libtpu is single-process-per-chip-set with no virtualization —
+partitioning must be airtight; SURVEY.md "hard parts").
+
+Discovery order: explicit override (KUKEON_TPU_CHIPS — used by tests and CI
+hosts without TPUs), /dev/accel* device nodes (TPU-VM), /dev/vfio groups.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+
+from kukeon_tpu.runtime.errors import FailedPrecondition
+from kukeon_tpu.runtime.metadata import MetadataStore
+
+ALLOC_FILE = "tpu-allocations.json"
+
+
+def discover_chips() -> list[int]:
+    override = os.environ.get("KUKEON_TPU_CHIPS")
+    if override is not None:
+        override = override.strip()
+        if not override:
+            return []
+        return [int(x) for x in override.split(",")]
+    nodes = glob.glob("/dev/accel*")
+    chips = []
+    for n in nodes:
+        m = re.search(r"accel(?:_)?(\d+)$", n)
+        if m:
+            chips.append(int(m.group(1)))
+    if chips:
+        return sorted(chips)
+    vfio = glob.glob("/dev/vfio/[0-9]*")
+    return sorted(int(os.path.basename(v)) for v in vfio)
+
+
+class TPUDeviceManager:
+    """Chip accounting, persisted so daemon restarts keep allocations."""
+
+    def __init__(self, store: MetadataStore, chips: list[int] | None = None):
+        self.store = store
+        self.chips = chips if chips is not None else discover_chips()
+
+    # allocations: {str(chip_id): "realm/space/stack/cell"}
+
+    def _load(self) -> dict[str, str]:
+        return self.store.read_json_or({}, ALLOC_FILE)
+
+    def _save(self, allocs: dict[str, str]) -> None:
+        self.store.write_json(allocs, ALLOC_FILE)
+
+    def allocated(self) -> dict[int, str]:
+        return {int(k): v for k, v in self._load().items()}
+
+    def free_chips(self) -> list[int]:
+        used = set(self.allocated())
+        return [c for c in self.chips if c not in used]
+
+    def allocate(self, owner: str, n: int) -> list[int]:
+        """Grant n chips to ``owner`` (idempotent: an existing grant of the
+        right size is returned as-is; a wrong-size grant is resized)."""
+        with self.store.lock():
+            allocs = self._load()
+            mine = sorted(int(k) for k, v in allocs.items() if v == owner)
+            if len(mine) == n:
+                return mine
+            for c in mine:   # resize: release then re-grant
+                del allocs[str(c)]
+            free = [c for c in self.chips if str(c) not in allocs]
+            if len(free) < n:
+                raise FailedPrecondition(
+                    f"not enough TPU chips: want {n}, free {len(free)} of {len(self.chips)}"
+                )
+            grant = free[:n]
+            for c in grant:
+                allocs[str(c)] = owner
+            self._save(allocs)
+            return grant
+
+    def release(self, owner: str) -> None:
+        with self.store.lock():
+            allocs = self._load()
+            remaining = {k: v for k, v in allocs.items() if v != owner}
+            if len(remaining) != len(allocs):
+                self._save(remaining)
+
+    @staticmethod
+    def visibility_env(chips: list[int]) -> dict[str, str]:
+        """Env that restricts libtpu/JAX to exactly these chips.
+
+        TPU_VISIBLE_DEVICES is the libtpu chip-visibility knob on TPU-VMs;
+        TPU_CHIPS_PER_PROCESS_BOUNDS/TPU_PROCESS_BOUNDS pin the topology for
+        a chip subset (the multi-process-per-host recipe). KUKEON_TPU_DEVICES
+        carries the raw device paths for backends that bind-mount nodes.
+        """
+        ids = ",".join(str(c) for c in chips)
+        n = len(chips)
+        env = {
+            "TPU_VISIBLE_DEVICES": ids,
+            "KUKEON_TPU_DEVICES": ",".join(f"/dev/accel{c}" for c in chips),
+        }
+        if 0 < n <= 4:
+            env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"{n},1,1"
+            env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+        return env

@@ -1,0 +1,65 @@
+"""Sentinel error vocabulary for the runtime (reference: internal/errdefs).
+
+Typed exceptions that map 1:1 onto RPC error codes, so the daemon can send a
+code over the wire and the client can re-raise the same type
+(reference: internal/errdefs/errdefs.go + pkg/api/kukeonv1/errmap.go).
+"""
+
+from __future__ import annotations
+
+
+class KukeonError(Exception):
+    """Base class; ``code`` crosses the RPC boundary."""
+
+    code = "internal"
+
+
+class NotFound(KukeonError):
+    code = "not_found"
+
+
+class AlreadyExists(KukeonError):
+    code = "already_exists"
+
+
+class InvalidArgument(KukeonError):
+    code = "invalid_argument"
+
+
+class FailedPrecondition(KukeonError):
+    code = "failed_precondition"
+
+
+class Conflict(KukeonError):
+    code = "conflict"
+
+
+class Unavailable(KukeonError):
+    code = "unavailable"
+
+
+class PermissionDenied(KukeonError):
+    code = "permission_denied"
+
+
+class DiskPressure(FailedPrecondition):
+    code = "disk_pressure"
+
+
+class NotSupported(KukeonError):
+    code = "not_supported"
+
+
+_BY_CODE = {
+    cls.code: cls
+    for cls in (
+        KukeonError, NotFound, AlreadyExists, InvalidArgument,
+        FailedPrecondition, Conflict, Unavailable, PermissionDenied,
+        DiskPressure, NotSupported,
+    )
+}
+
+
+def from_code(code: str, message: str) -> KukeonError:
+    """Rehydrate a typed error from its wire code (client side)."""
+    return _BY_CODE.get(code, KukeonError)(message)

@@ -1,0 +1,122 @@
+"""On-disk metadata store: JSON files with locking under the run path.
+
+Reference: internal/metadata (metadata.go:30-45, lock.go). Every resource's
+desired spec + status persists as one JSON file; the daemon can die at any
+point and the eager reconcile pass re-derives live state (metadata-first
+design, SURVEY.md section 5.4).
+
+Writes are atomic (tempfile + rename) and serialized by an fcntl lock file
+per directory, so the daemon and in-process CLI clients can share the store.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import json
+import os
+import tempfile
+from typing import Any, Iterator
+
+
+class MetadataStore:
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+
+    # --- paths -------------------------------------------------------------
+
+    def path(self, *parts: str) -> str:
+        p = os.path.join(self.root, *parts)
+        # Normalize and require the result to be root itself or inside it
+        # (plain startswith would let "../kukeon-backup" match "/kukeon").
+        ap = os.path.abspath(p)
+        if ap != self.root and not ap.startswith(self.root + os.sep):
+            raise ValueError(f"path escapes store root: {parts}")
+        return p
+
+    def ensure_dir(self, *parts: str, mode: int = 0o750) -> str:
+        p = self.path(*parts)
+        os.makedirs(p, mode=mode, exist_ok=True)
+        return p
+
+    # --- locking -----------------------------------------------------------
+
+    @contextlib.contextmanager
+    def lock(self, *parts: str) -> Iterator[None]:
+        """Exclusive advisory lock scoped to a directory."""
+        d = self.ensure_dir(*parts)
+        lock_path = os.path.join(d, ".lock")
+        fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o600)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    # --- JSON documents ----------------------------------------------------
+
+    def write_json(self, doc: Any, *parts: str, mode: int = 0o640) -> str:
+        p = self.path(*parts)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(p), prefix=".tmp-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, indent=2, sort_keys=True)
+                f.write("\n")
+            os.chmod(tmp, mode)
+            os.replace(tmp, p)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            raise
+        return p
+
+    def read_json(self, *parts: str) -> Any:
+        with open(self.path(*parts)) as f:
+            return json.load(f)
+
+    def read_json_or(self, default: Any, *parts: str) -> Any:
+        try:
+            return self.read_json(*parts)
+        except FileNotFoundError:
+            return default
+
+    def exists(self, *parts: str) -> bool:
+        return os.path.exists(self.path(*parts))
+
+    def delete(self, *parts: str) -> bool:
+        try:
+            os.unlink(self.path(*parts))
+            return True
+        except FileNotFoundError:
+            return False
+
+    def delete_tree(self, *parts: str) -> bool:
+        import shutil
+
+        p = self.path(*parts)
+        if not os.path.exists(p):
+            return False
+        shutil.rmtree(p)
+        return True
+
+    def list_dirs(self, *parts: str) -> list[str]:
+        p = self.path(*parts)
+        try:
+            return sorted(
+                d for d in os.listdir(p)
+                if os.path.isdir(os.path.join(p, d)) and not d.startswith(".")
+            )
+        except FileNotFoundError:
+            return []
+
+    def list_files(self, *parts: str, suffix: str = ".json") -> list[str]:
+        p = self.path(*parts)
+        try:
+            return sorted(
+                f for f in os.listdir(p)
+                if f.endswith(suffix) and not f.startswith(".")
+            )
+        except FileNotFoundError:
+            return []

@@ -1,0 +1,143 @@
+"""Internal (version-agnostic) resource records — the modelhub analog.
+
+Reference: internal/modelhub (cell.go:21-100): the controller/runner operate
+on these, not on wire docs. Records carry Generation/ObservedGeneration,
+provenance (config/blueprint lineage) and runtime status, and round-trip
+through the metadata store as JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass, field
+
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.api.wire import from_wire, to_wire
+
+# Cell phases.
+PENDING = "pending"
+READY = "ready"          # all containers running
+DEGRADED = "degraded"    # some containers running
+STOPPED = "stopped"
+FAILED = "failed"
+
+# Container states.
+C_CREATED = "created"
+C_RUNNING = "running"
+C_EXITED = "exited"
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    state: str = C_CREATED
+    pid: int | None = None
+    exit_code: int | None = None
+    restarts: int = 0
+    started_at: float | None = None
+    finished_at: float | None = None
+    last_restart_at: float | None = None
+
+
+@dataclass
+class Provenance:
+    config: str | None = None
+    blueprint: str | None = None
+    team: str | None = None
+
+
+@dataclass
+class CellStatus:
+    phase: str = PENDING
+    reason: str | None = None
+    containers: list[ContainerStatus] = field(default_factory=list)
+    observed_generation: int = 0
+    tpu_chips: list[int] = field(default_factory=list)   # chips granted
+
+    def container(self, name: str) -> ContainerStatus | None:
+        for c in self.containers:
+            if c.name == name:
+                return c
+        return None
+
+
+@dataclass
+class CellRecord:
+    realm: str = ""
+    space: str = ""
+    stack: str = ""
+    name: str = ""
+    spec: t.CellSpec = field(default_factory=t.CellSpec)
+    labels: dict[str, str] = field(default_factory=dict)
+    provenance: Provenance = field(default_factory=Provenance)
+    generation: int = 1
+    created_at: float = field(default_factory=time.time)
+    desired_state: str = "running"   # running | stopped
+    status: CellStatus = field(default_factory=CellStatus)
+
+    def to_json(self) -> dict:
+        return to_wire(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "CellRecord":
+        return from_wire(CellRecord, d)
+
+
+@dataclass
+class ScopeRecord:
+    """Realm / Space / Stack metadata record."""
+
+    kind: str = ""
+    name: str = ""
+    realm: str | None = None
+    space: str | None = None
+    labels: dict[str, str] = field(default_factory=dict)
+    spec_json: dict = field(default_factory=dict)
+    created_at: float = field(default_factory=time.time)
+
+    def to_json(self) -> dict:
+        return to_wire(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "ScopeRecord":
+        return from_wire(ScopeRecord, d)
+
+
+@dataclass
+class VolumeRecord:
+    realm: str = ""
+    space: str | None = None
+    stack: str | None = None
+    name: str = ""
+    reclaim_policy: str = "delete"
+    labels: dict[str, str] = field(default_factory=dict)
+    created_at: float = field(default_factory=time.time)
+
+    def to_json(self) -> dict:
+        return to_wire(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "VolumeRecord":
+        return from_wire(VolumeRecord, d)
+
+
+def cell_record_from_doc(doc: t.Document) -> CellRecord:
+    md = doc.metadata
+    return CellRecord(
+        realm=md.realm, space=md.space, stack=md.stack, name=md.name,
+        spec=doc.spec, labels=dict(md.labels),
+        provenance=Provenance(
+            config=md.labels.get("kukeon.io/config"),
+            blueprint=md.labels.get("kukeon.io/blueprint"),
+            team=md.labels.get("kukeon.io/team"),
+        ),
+    )
+
+
+def spec_to_json(spec) -> dict:
+    return to_wire(spec)
+
+
+def cell_spec_from_json(d: dict) -> t.CellSpec:
+    return from_wire(t.CellSpec, d)

@@ -1,0 +1,33 @@
+"""Hierarchy-name validation and ID scheme (reference: internal/util/naming).
+
+Names are DNS-label-ish: lowercase alphanumerics and '-', must start/end
+alphanumeric, max 63 chars. Container runtime IDs follow the reference's
+``<space>_<stack>_<cell>[_<container>]`` scheme (naming.go:28-64).
+"""
+
+from __future__ import annotations
+
+import re
+import secrets
+
+from kukeon_tpu.runtime.errors import InvalidArgument
+
+_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+
+
+def validate_name(name: str, what: str = "name") -> str:
+    if not isinstance(name, str) or not _NAME_RE.match(name):
+        raise InvalidArgument(
+            f"invalid {what} {name!r}: must match [a-z0-9]([a-z0-9-]*[a-z0-9])?, max 63 chars"
+        )
+    return name
+
+
+def runtime_id(space: str, stack: str, cell: str, container: str | None = None) -> str:
+    parts = [space, stack, cell] + ([container] if container else [])
+    return "_".join(parts)
+
+
+def random_cell_name(prefix: str = "cell") -> str:
+    """``<prefix>-<6hex>`` (reference: cellname.go:39-61)."""
+    return f"{prefix}-{secrets.token_hex(3)}"

@@ -1,0 +1,515 @@
+"""The imperative resource engine beneath the controller.
+
+Reference: internal/controller/runner (provision.go, start.go, refresh.go,
+cell_lock.go — 33.7k LoC of Go). Responsibilities here:
+
+- provision realm/space/stack/cell trees (metadata dirs + cgroups),
+- cell lifecycle: create/start/stop/kill/delete with per-cell locking and a
+  10s SIGTERM->SIGKILL stop window (reference: ctr/container.go:173),
+- TPU chip affinity: allocate chips at start, inject visibility env,
+  release at stop (the libtpu device-manager seam, BASELINE north star),
+- secret staging (files 0400 + env injection; reference ctr/secrets.go),
+- model cells: materialize the in-tree serving container,
+- refresh: re-derive status from the backend, enforce restart policy
+  (always/on-failure/never + backoff + max retries; refresh.go:1110-1458)
+  and AutoDelete reaping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import threading
+import time
+
+from kukeon_tpu.runtime import consts, model
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.cells.backend import CellBackend, ContainerContext
+from kukeon_tpu.runtime.cgroups import CgroupManager
+from kukeon_tpu.runtime.devices import TPUDeviceManager
+from kukeon_tpu.runtime.errors import (
+    DiskPressure,
+    FailedPrecondition,
+    NotFound,
+)
+from kukeon_tpu.runtime.store import ResourceStore
+
+# Reconcile outcomes (reference: runner/runner.go:33-56).
+OUTCOME_STEADY = "steady"
+OUTCOME_HEALED = "healed"
+OUTCOME_RESTARTED = "restarted"
+OUTCOME_AUTO_DELETED = "auto-deleted"
+OUTCOME_VANISHED = "vanished"
+
+
+@dataclasses.dataclass
+class RunnerOptions:
+    stop_grace_s: float = consts.DEFAULT_STOP_GRACE_S
+    disk_pressure_block_pct: float = consts.DISK_PRESSURE_BLOCK_PCT
+    serving_python: str = sys.executable
+
+
+class Runner:
+    def __init__(
+        self,
+        store: ResourceStore,
+        backend: CellBackend,
+        cgroups: CgroupManager | None = None,
+        devices: TPUDeviceManager | None = None,
+        options: RunnerOptions | None = None,
+    ):
+        self.store = store
+        self.backend = backend
+        self.cgroups = cgroups
+        self.devices = devices or TPUDeviceManager(store.ms, chips=[])
+        self.opts = options or RunnerOptions()
+        self._cell_locks: dict[tuple, threading.Lock] = {}
+        self._locks_guard = threading.Lock()
+
+    # --- locking (reference: runner/cell_lock.go) --------------------------
+
+    def cell_lock(self, realm: str, space: str, stack: str, cell: str) -> threading.Lock:
+        key = (realm, space, stack, cell)
+        with self._locks_guard:
+            return self._cell_locks.setdefault(key, threading.Lock())
+
+    # --- provisioning ------------------------------------------------------
+
+    def ensure_realm(self, name: str, spec: t.RealmSpec | None = None,
+                     labels: dict | None = None) -> None:
+        self.store.ms.ensure_dir(*self.store.realm_parts(name))
+        if not self.store.ms.exists(*self.store.realm_parts(name), "realm.json"):
+            rec = model.ScopeRecord(kind="Realm", name=name, labels=labels or {},
+                                    spec_json=model.spec_to_json(spec or t.RealmSpec()))
+            self.store.write_scope(rec)
+        if self.cgroups:
+            self.cgroups.ensure(name)
+
+    def ensure_space(self, realm: str, name: str, spec: t.SpaceSpec | None = None,
+                     labels: dict | None = None) -> None:
+        self.store.read_realm(realm)
+        self.store.ms.ensure_dir(*self.store.space_parts(realm, name))
+        existing = self.store.ms.read_json_or(None, *self.store.space_parts(realm, name), "space.json")
+        if existing is None or spec is not None:
+            rec = model.ScopeRecord(kind="Space", name=name, realm=realm,
+                                    labels=labels or {},
+                                    spec_json=model.spec_to_json(spec or t.SpaceSpec()))
+            self.store.write_scope(rec)
+        if self.cgroups:
+            self.cgroups.ensure(realm, name)
+
+    def ensure_stack(self, realm: str, space: str, name: str,
+                     spec: t.StackSpec | None = None, labels: dict | None = None) -> None:
+        self.store.read_space(realm, space)
+        self.store.ms.ensure_dir(*self.store.stack_parts(realm, space, name))
+        if not self.store.ms.exists(*self.store.stack_parts(realm, space, name), "stack.json"):
+            rec = model.ScopeRecord(kind="Stack", name=name, realm=realm, space=space,
+                                    labels=labels or {},
+                                    spec_json=model.spec_to_json(spec or t.StackSpec()))
+            self.store.write_scope(rec)
+        if self.cgroups:
+            self.cgroups.ensure(realm, space, name)
+
+    # --- disk pressure (reference: runner/create_cell.go:166) --------------
+
+    def guard_disk_pressure(self, ignore: bool = False) -> None:
+        if ignore:
+            return
+        try:
+            st = os.statvfs(self.store.ms.root)
+        except OSError:
+            return
+        used_pct = 100.0 * (1 - st.f_bavail / max(st.f_blocks, 1))
+        if used_pct >= self.opts.disk_pressure_block_pct:
+            raise DiskPressure(
+                f"disk {used_pct:.1f}% full >= block threshold "
+                f"{self.opts.disk_pressure_block_pct}%; refusing new cells"
+            )
+
+    # --- cell lifecycle ----------------------------------------------------
+
+    def create_cell(self, rec: model.CellRecord) -> model.CellRecord:
+        with self.cell_lock(rec.realm, rec.space, rec.stack, rec.name):
+            self.store.read_stack(rec.realm, rec.space, rec.stack)
+            self.guard_disk_pressure(rec.spec.ignore_disk_pressure)
+            self.store.ms.ensure_dir(
+                *self.store.cell_parts(rec.realm, rec.space, rec.stack, rec.name)
+            )
+            if self.cgroups:
+                self.cgroups.ensure(rec.realm, rec.space, rec.stack, rec.name)
+            rec.status = model.CellStatus(
+                phase=model.PENDING,
+                containers=[
+                    model.ContainerStatus(name=c.name)
+                    for c in self.cell_containers(rec)
+                ],
+            )
+            self.store.write_cell(rec)
+            return rec
+
+    def cell_containers(self, rec: model.CellRecord) -> list[t.ContainerSpec]:
+        """Declared containers plus the materialized serving container for
+        model cells."""
+        containers = list(rec.spec.containers)
+        if rec.spec.model is not None:
+            containers.append(self._model_container(rec.spec.model))
+        return containers
+
+    def _model_container(self, m: t.ModelSpec) -> t.ContainerSpec:
+        cmd = [
+            self.opts.serving_python, "-m", "kukeon_tpu.runtime.serving_cell",
+            "--model", m.model, "--port", str(m.port),
+            "--num-slots", str(m.num_slots),
+        ]
+        if m.max_seq_len:
+            cmd += ["--max-seq-len", str(m.max_seq_len)]
+        if m.checkpoint:
+            cmd += ["--checkpoint", m.checkpoint]
+        if m.dtype:
+            cmd += ["--dtype", m.dtype]
+        return t.ContainerSpec(
+            name="model-server",
+            command=cmd,
+            resources=t.Resources(tpu_chips=m.chips),
+            restart_policy=t.RestartPolicy(policy="always", backoff_seconds=2.0),
+            ports=[t.PortSpec(port=m.port, name="http")],
+        )
+
+    def _owner_key(self, rec: model.CellRecord) -> str:
+        return f"{rec.realm}/{rec.space}/{rec.stack}/{rec.name}"
+
+    def start_cell(self, realm: str, space: str, stack: str, name: str) -> model.CellRecord:
+        with self.cell_lock(realm, space, stack, name):
+            rec = self.store.read_cell(realm, space, stack, name)
+            return self._start_cell_locked(rec)
+
+    def _start_cell_locked(self, rec: model.CellRecord) -> model.CellRecord:
+        containers = self.cell_containers(rec)
+        total_chips = sum(
+            c.resources.tpu_chips or 0 for c in containers
+        )
+        chips: list[int] = []
+        if total_chips:
+            chips = self.devices.allocate(self._owner_key(rec), total_chips)
+        rec.status.tpu_chips = chips
+
+        slices = self._chip_slices(containers, chips)
+        new_statuses = []
+        for spec in containers:
+            ctx = self._container_context(rec, spec)
+            grant = slices.get(spec.name, [])
+            if grant:
+                ctx.env.update(self.devices.visibility_env(grant))
+            st = rec.status.container(spec.name) or model.ContainerStatus(name=spec.name)
+            live = self.backend.container_state(ctx)
+            if not live.running:
+                self.backend.start_container(ctx)
+                live = self.backend.container_state(ctx)
+                st.started_at = time.time()
+            st.state = live.state
+            st.pid = live.pid
+            st.exit_code = live.exit_code
+            new_statuses.append(st)
+
+        rec.status.containers = new_statuses
+        rec.desired_state = "running"
+        self._derive_phase(rec)
+        self.store.write_cell(rec)
+        return rec
+
+    @staticmethod
+    def _chip_slices(containers: list[t.ContainerSpec], chips: list[int]) -> dict[str, list[int]]:
+        """Deterministic per-container chip assignment: declaration order
+        partitions the cell's grant. Start and restart paths share this so a
+        restarted container gets back ITS chips, not a sibling's."""
+        out: dict[str, list[int]] = {}
+        cursor = 0
+        for spec in containers:
+            n = spec.resources.tpu_chips or 0
+            if n:
+                out[spec.name] = chips[cursor : cursor + n]
+                cursor += n
+        return out
+
+    def _container_context(self, rec: model.CellRecord, spec: t.ContainerSpec) -> ContainerContext:
+        cdir = self.store.container_dir(rec.realm, rec.space, rec.stack, rec.name, spec.name)
+        env: dict[str, str] = {
+            "KUKEON_REALM": rec.realm,
+            "KUKEON_SPACE": rec.space,
+            "KUKEON_STACK": rec.stack,
+            "KUKEON_CELL": rec.name,
+            "KUKEON_CONTAINER": spec.name,
+        }
+        for e in spec.env:
+            env[e.name] = e.value
+        self._stage_secrets(rec, spec, cdir, env)
+        self._mount_volumes(rec, spec, cdir, env)
+
+        cgroup_dir = None
+        if self.cgroups and self.cgroups.available():
+            cgroup_dir = self.cgroups.ensure(
+                rec.realm, rec.space, rec.stack, rec.name, spec.name
+            )
+            self.cgroups.apply_limits(
+                cgroup_dir,
+                memory=spec.resources.memory,
+                cpu=spec.resources.cpu,
+                pids=spec.resources.pids,
+            )
+        return ContainerContext(
+            container_dir=cdir,
+            spec=spec,
+            env=env,
+            command=list(spec.command) + list(spec.args),
+            cgroup_dir=cgroup_dir,
+            workdir=spec.workdir,
+        )
+
+    def _stage_secrets(self, rec: model.CellRecord, spec: t.ContainerSpec,
+                       cdir: str, env: dict[str, str]) -> None:
+        """Stage referenced secrets (reference: ctr/secrets.go:30-60,
+        mode 0400) and/or export env vars."""
+        if not spec.secrets:
+            return
+        sdir = os.path.join(cdir, "secrets")
+        os.makedirs(sdir, mode=0o700, exist_ok=True)
+        for ref in spec.secrets:
+            doc = self.store.resolve_scoped(
+                consts.SECRETS_DIR, rec.realm, rec.space, rec.stack, ref.name
+            )
+            if doc is None:
+                raise NotFound(
+                    f"secret {ref.name!r} not found in scope "
+                    f"{rec.realm}/{rec.space}/{rec.stack}"
+                )
+            data: dict[str, str] = doc.get("data", {})
+            if ref.env:
+                if len(data) == 1:
+                    env[ref.env] = next(iter(data.values()))
+                else:
+                    for k, v in data.items():
+                        env[f"{ref.env}_{k}"] = v
+            path = ref.path or os.path.join(sdir, f"{ref.name}.env")
+            content = "".join(f"{k}={v}\n" for k, v in sorted(data.items()))
+            # The staged file is 0400; restaging (stop/start, restart policy)
+            # must replace it, not reopen it (O_TRUNC on a 0400 file EACCESes
+            # for non-root daemons).
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o400)
+            try:
+                os.write(fd, content.encode())
+            finally:
+                os.close(fd)
+            env[f"KUKEON_SECRET_{ref.name.upper().replace('-', '_')}"] = path
+
+    def _mount_volumes(self, rec: model.CellRecord, spec: t.ContainerSpec,
+                       cdir: str, env: dict[str, str]) -> None:
+        """Process-backend volume binding: each Volume kind owns a data dir
+        under its scope; the container gets its path via env (a containerd
+        backend would bind-mount instead)."""
+        for vm in spec.volumes:
+            if vm.name is None:
+                continue
+            vol = self.store.resolve_scoped(
+                consts.VOLUMES_DIR + "-meta", rec.realm, rec.space, rec.stack, vm.name
+            ) or self.store.resolve_scoped(
+                consts.VOLUMES_DIR, rec.realm, rec.space, rec.stack, vm.name
+            )
+            if vol is None:
+                raise NotFound(f"volume {vm.name!r} not found in scope")
+            data_dir = vol.get("dataDir")
+            if data_dir:
+                env[f"KUKEON_VOLUME_{vm.name.upper().replace('-', '_')}"] = data_dir
+
+    def stop_cell(self, realm: str, space: str, stack: str, name: str,
+                  grace_s: float | None = None) -> model.CellRecord:
+        import signal as _signal
+
+        grace = self.opts.stop_grace_s if grace_s is None else grace_s
+        with self.cell_lock(realm, space, stack, name):
+            rec = self.store.read_cell(realm, space, stack, name)
+            contexts = [
+                self._container_context_bare(rec, spec)
+                for spec in self.cell_containers(rec)
+            ]
+            for ctx in contexts:
+                if self.backend.container_state(ctx).running:
+                    self.backend.signal_container(ctx, _signal.SIGTERM)
+            deadline = time.monotonic() + grace
+            while time.monotonic() < deadline:
+                if not any(self.backend.container_state(c).running for c in contexts):
+                    break
+                time.sleep(0.05)
+            for ctx in contexts:
+                if self.backend.container_state(ctx).running:
+                    self.backend.signal_container(ctx, _signal.SIGKILL)
+            self._finish_stop(rec, contexts)
+            return rec
+
+    def kill_cell(self, realm: str, space: str, stack: str, name: str) -> model.CellRecord:
+        import signal as _signal
+
+        with self.cell_lock(realm, space, stack, name):
+            rec = self.store.read_cell(realm, space, stack, name)
+            contexts = [
+                self._container_context_bare(rec, spec)
+                for spec in self.cell_containers(rec)
+            ]
+            for ctx in contexts:
+                if self.backend.container_state(ctx).running:
+                    self.backend.signal_container(ctx, _signal.SIGKILL)
+            self._finish_stop(rec, contexts)
+            return rec
+
+    def _container_context_bare(self, rec: model.CellRecord, spec: t.ContainerSpec) -> ContainerContext:
+        """Context sufficient for signal/state/cleanup (no env building)."""
+        cdir = self.store.container_dir(rec.realm, rec.space, rec.stack, rec.name, spec.name)
+        return ContainerContext(container_dir=cdir, spec=spec, command=list(spec.command))
+
+    def _finish_stop(self, rec: model.CellRecord, contexts: list[ContainerContext]) -> None:
+        for ctx, st in zip(contexts, rec.status.containers):
+            live = self.backend.container_state(ctx)
+            st.state = live.state
+            st.exit_code = live.exit_code
+            st.pid = None
+            st.finished_at = time.time()
+        rec.desired_state = "stopped"
+        rec.status.phase = model.STOPPED
+        if rec.status.tpu_chips:
+            self.devices.release(self._owner_key(rec))
+            rec.status.tpu_chips = []
+        self.store.write_cell(rec)
+
+    def delete_cell(self, realm: str, space: str, stack: str, name: str,
+                    force: bool = False) -> None:
+        rec = self.store.read_cell(realm, space, stack, name)
+        running = any(c.state == model.C_RUNNING for c in rec.status.containers)
+        if running:
+            if not force:
+                raise FailedPrecondition(
+                    f"cell {name!r} is running; stop it first or use force"
+                )
+            self.kill_cell(realm, space, stack, name)
+        with self.cell_lock(realm, space, stack, name):
+            for spec in self.cell_containers(rec):
+                self.backend.cleanup_container(self._container_context_bare(rec, spec))
+            self.devices.release(self._owner_key(rec))
+            self.store.delete_cell_tree(realm, space, stack, name)
+            if self.cgroups:
+                self.cgroups.remove(realm, space, stack, name)
+
+    # --- refresh / restart policy (reference: refresh.go:1110-1458) --------
+
+    def refresh_cell(self, realm: str, space: str, stack: str, name: str) -> tuple[model.CellRecord | None, str]:
+        with self.cell_lock(realm, space, stack, name):
+            try:
+                rec = self.store.read_cell(realm, space, stack, name)
+            except NotFound:
+                return None, OUTCOME_VANISHED
+            return self._refresh_locked(rec)
+
+    def _refresh_locked(self, rec: model.CellRecord) -> tuple[model.CellRecord, str]:
+        outcome = OUTCOME_STEADY
+        containers = self.cell_containers(rec)
+        changed = False
+
+        for spec in containers:
+            st = rec.status.container(spec.name)
+            if st is None:
+                st = model.ContainerStatus(name=spec.name)
+                rec.status.containers.append(st)
+            ctx = self._container_context_bare(rec, spec)
+            live = self.backend.container_state(ctx)
+            if (live.state, live.pid, live.exit_code) != (st.state, st.pid, st.exit_code):
+                if st.state != live.state:
+                    changed = True
+                st.state = live.state
+                st.pid = live.pid
+                st.exit_code = live.exit_code
+                if live.exited and st.finished_at is None:
+                    st.finished_at = time.time()
+
+            if (
+                rec.desired_state == "running"
+                and live.exited
+                and self._restart_due(spec, st)
+            ):
+                ctx_full = self._container_context(rec, spec)
+                grant = self._chip_slices(containers, rec.status.tpu_chips).get(spec.name, [])
+                if grant:
+                    # Reuse the cell's grant (stable across restarts).
+                    ctx_full.env.update(self.devices.visibility_env(grant))
+                self.backend.start_container(ctx_full)
+                live = self.backend.container_state(ctx_full)
+                st.state = live.state
+                st.pid = live.pid
+                st.exit_code = live.exit_code
+                st.restarts += 1
+                st.last_restart_at = time.time()
+                st.finished_at = None
+                outcome = OUTCOME_RESTARTED
+                changed = True
+
+        # AutoDelete: reap once every container has exited
+        # (reference: runner/runner.go:33-45).
+        if (
+            rec.spec.auto_delete
+            and rec.desired_state == "running"
+            and rec.status.containers
+            and all(c.state == model.C_EXITED for c in rec.status.containers)
+        ):
+            self._finish_stop(rec, [
+                self._container_context_bare(rec, spec) for spec in containers
+            ])
+            for spec in containers:
+                self.backend.cleanup_container(self._container_context_bare(rec, spec))
+            self.store.delete_cell_tree(rec.realm, rec.space, rec.stack, rec.name)
+            if self.cgroups:
+                self.cgroups.remove(rec.realm, rec.space, rec.stack, rec.name)
+            return rec, OUTCOME_AUTO_DELETED
+
+        old_phase = rec.status.phase
+        self._derive_phase(rec)
+        if changed or rec.status.phase != old_phase:
+            self.store.write_cell(rec)
+            if outcome == OUTCOME_STEADY:
+                outcome = OUTCOME_HEALED
+        return rec, outcome
+
+    def _restart_due(self, spec: t.ContainerSpec, st: model.ContainerStatus) -> bool:
+        rp = spec.restart_policy
+        if rp.policy == "never":
+            return False
+        if rp.policy == "on-failure" and (st.exit_code == 0):
+            return False
+        if rp.max_retries is not None and st.restarts >= rp.max_retries:
+            return False
+        anchor = st.last_restart_at or st.finished_at
+        if anchor is not None and (time.time() - anchor) < rp.backoff_seconds:
+            return False
+        return True
+
+    def _derive_phase(self, rec: model.CellRecord) -> None:
+        states = [c.state for c in rec.status.containers]
+        if not states:
+            rec.status.phase = model.PENDING
+            return
+        if rec.desired_state == "stopped":
+            rec.status.phase = model.STOPPED
+            return
+        running = sum(1 for s in states if s == model.C_RUNNING)
+        if running == len(states):
+            rec.status.phase = model.READY
+        elif running > 0:
+            rec.status.phase = model.DEGRADED
+        elif all(s == model.C_EXITED for s in states):
+            failed = any(
+                (c.exit_code or 0) != 0 for c in rec.status.containers
+            )
+            rec.status.phase = model.FAILED if failed else model.STOPPED
+        else:
+            rec.status.phase = model.PENDING

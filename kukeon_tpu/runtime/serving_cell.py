@@ -1,0 +1,217 @@
+"""Model-serving cell entrypoint: HTTP front-end over the ServingEngine.
+
+The in-tree serving workload the runner materializes for ``CellSpec.model``
+(BASELINE north star: "an in-tree JetStream (JAX/XLA) inference cell"). The
+runner grants chips via TPU_VISIBLE_DEVICES before exec; this process builds
+the mesh over whatever devices JAX exposes and serves:
+
+  GET  /v1/health    -> {"status": "ok", ...}  (the reconciler's health seam)
+  GET  /v1/stats     -> slots/queue/throughput counters
+  POST /v1/generate  -> {"promptTokens": [...] | "prompt": "text",
+                         "maxNewTokens": N, "temperature": T, ...}
+                        => {"tokens": [...], "text": "..."}
+
+Tokenization: checkpoint-less engines (random init, dev/e2e) use a byte
+tokenizer (id = byte + 1); real deployments pass a HF tokenizer name.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+MODELS = {}
+
+
+def _register_models():
+    from kukeon_tpu.models import llama
+
+    MODELS.update({
+        "tiny": llama.llama_tiny,
+        "llama3-1b": llama.llama3_1b,
+        "llama3-8b": llama.llama3_8b,
+    })
+
+
+class ByteTokenizer:
+    """Fallback tokenizer: utf-8 bytes shifted by 1 (0 is pad)."""
+
+    def encode(self, text: str) -> list[int]:
+        return [b + 1 for b in text.encode()]
+
+    def decode(self, ids: list[int]) -> str:
+        # Ids beyond the byte range (random-init models sample the whole
+        # vocab) degrade to '?' rather than erroring.
+        return bytes(
+            (i - 1) if 0 < i <= 256 else 0x3F for i in ids if i > 0
+        ).decode(errors="replace")
+
+
+class ServingCell:
+    def __init__(self, model: str, *, num_slots: int, max_seq_len: int | None,
+                 checkpoint: str | None, dtype: str | None, seed: int = 0):
+        import jax
+
+        from kukeon_tpu.models import llama
+        from kukeon_tpu.parallel import auto_mesh_shape, make_mesh
+        from kukeon_tpu.serving import ServingEngine
+
+        _register_models()
+        if model not in MODELS:
+            raise SystemExit(f"unknown model {model!r}; known: {sorted(MODELS)}")
+        cfg = MODELS[model]()
+        if dtype:
+            import jax.numpy as jnp
+
+            cfg = __import__("dataclasses").replace(cfg, dtype=getattr(jnp, dtype))
+        if max_seq_len:
+            cfg = __import__("dataclasses").replace(cfg, max_seq_len=max_seq_len)
+
+        n = len(jax.devices())
+        shape = auto_mesh_shape(n)
+        mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
+
+        if checkpoint:
+            params = self._load_checkpoint(checkpoint, cfg)
+        else:
+            params = llama.init_params(jax.random.key(seed), cfg)
+
+        self.model_name = model
+        self.cfg = cfg
+        self.engine = ServingEngine(
+            cfg, params, mesh, num_slots=num_slots,
+            max_seq_len=max_seq_len or min(cfg.max_seq_len, 4096),
+        )
+        self.tokenizer = ByteTokenizer()
+        self.started_at = time.time()
+        self.total_tokens = 0
+        self._stats_lock = threading.Lock()
+
+    @staticmethod
+    def _load_checkpoint(path: str, cfg):
+        import jax
+        import orbax.checkpoint as ocp
+
+        from kukeon_tpu.models import llama
+
+        abstract = jax.eval_shape(lambda k: llama.init_params(k, cfg), jax.random.key(0))
+        ckptr = ocp.StandardCheckpointer()
+        return ckptr.restore(path, abstract)
+
+    def warmup(self, prompt_len: int = 64):
+        self.engine.warmup(prompt_len)
+
+    def generate(self, req: dict) -> dict:
+        from kukeon_tpu.serving import SamplingParams
+
+        if "promptTokens" in req:
+            prompt = np.asarray(req["promptTokens"], np.int32)
+        elif "prompt" in req:
+            prompt = np.asarray(self.tokenizer.encode(req["prompt"]), np.int32)
+        else:
+            raise ValueError("need promptTokens or prompt")
+        sp = SamplingParams(
+            temperature=float(req.get("temperature", 0.0)),
+            top_k=int(req.get("topK", 0)),
+            top_p=float(req.get("topP", 1.0)),
+            max_new_tokens=int(req.get("maxNewTokens", 128)),
+        )
+        t0 = time.monotonic()
+        tokens = self.engine.generate(prompt, sp)
+        dt = time.monotonic() - t0
+        with self._stats_lock:
+            self.total_tokens += len(tokens)
+        return {
+            "tokens": tokens,
+            "text": self.tokenizer.decode(tokens),
+            "numTokens": len(tokens),
+            "seconds": round(dt, 4),
+        }
+
+    def stats(self) -> dict:
+        import jax
+
+        return {
+            "model": self.model_name,
+            "devices": [str(d) for d in jax.devices()],
+            "numSlots": self.engine.num_slots,
+            "freeSlots": len(self.engine._free_slots()),
+            "uptimeSeconds": round(time.time() - self.started_at, 1),
+            "totalTokens": self.total_tokens,
+        }
+
+
+def make_handler(cell: ServingCell):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *a):
+            sys.stderr.write("serving-cell: " + fmt % a + "\n")
+
+        def _send(self, code: int, obj: dict):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/v1/health":
+                self._send(200, {"status": "ok", "model": cell.model_name})
+            elif self.path == "/v1/stats":
+                self._send(200, cell.stats())
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/v1/generate":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                self._send(200, cell.generate(req))
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — server must keep serving
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kukeon-serving-cell")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--num-slots", type=int, default=8)
+    ap.add_argument("--max-seq-len", type=int, default=None)
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--dtype", default=None)
+    ap.add_argument("--no-warmup", action="store_true")
+    args = ap.parse_args(argv)
+
+    cell = ServingCell(
+        args.model, num_slots=args.num_slots, max_seq_len=args.max_seq_len,
+        checkpoint=args.checkpoint, dtype=args.dtype,
+    )
+    # Warmup before the engine thread starts: step() is single-driver.
+    if not args.no_warmup:
+        cell.warmup()
+    cell.engine.start()
+    server = ThreadingHTTPServer((args.host, args.port), make_handler(cell))
+    print(f"serving-cell: {args.model} ready on {args.host}:{args.port}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

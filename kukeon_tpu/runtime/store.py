@@ -1,0 +1,149 @@
+"""Typed resource store over the metadata layout.
+
+Path scheme (consts.py): realms/<r>/spaces/<s>/stacks/<st>/cells/<c>/...
+Scoped resources (secrets/blueprints/configs/volumes) live under their
+owning scope dir.
+"""
+
+from __future__ import annotations
+
+from kukeon_tpu.runtime import consts, model, naming
+from kukeon_tpu.runtime.errors import NotFound
+from kukeon_tpu.runtime.metadata import MetadataStore
+
+
+class ResourceStore:
+    def __init__(self, ms: MetadataStore):
+        self.ms = ms
+
+    # --- scope paths -------------------------------------------------------
+    # Every name becomes a path component, so every path helper validates —
+    # verbs like DeleteRealm must not accept "../../other" (the metadata
+    # store's escape guard is the backstop; this gives the clean error).
+
+    def realm_parts(self, realm: str) -> tuple[str, ...]:
+        naming.validate_name(realm, "realm")
+        return (consts.REALMS_DIR, realm)
+
+    def space_parts(self, realm: str, space: str) -> tuple[str, ...]:
+        naming.validate_name(space, "space")
+        return (*self.realm_parts(realm), consts.SPACES_DIR, space)
+
+    def stack_parts(self, realm: str, space: str, stack: str) -> tuple[str, ...]:
+        naming.validate_name(stack, "stack")
+        return (*self.space_parts(realm, space), consts.STACKS_DIR, stack)
+
+    def cell_parts(self, realm: str, space: str, stack: str, cell: str) -> tuple[str, ...]:
+        naming.validate_name(cell, "cell")
+        return (*self.stack_parts(realm, space, stack), consts.CELLS_DIR, cell)
+
+    def container_dir(self, realm: str, space: str, stack: str, cell: str, container: str) -> str:
+        return self.ms.ensure_dir(
+            *self.cell_parts(realm, space, stack, cell), consts.CONTAINERS_DIR, container
+        )
+
+    def scope_parts(self, realm: str, space: str | None, stack: str | None) -> tuple[str, ...]:
+        if stack is not None and space is not None:
+            return self.stack_parts(realm, space, stack)
+        if space is not None:
+            return self.space_parts(realm, space)
+        return self.realm_parts(realm)
+
+    # --- scope records -----------------------------------------------------
+
+    def write_scope(self, rec: model.ScopeRecord) -> None:
+        if rec.kind == "Realm":
+            parts = (*self.realm_parts(rec.name), "realm.json")
+        elif rec.kind == "Space":
+            parts = (*self.space_parts(rec.realm, rec.name), "space.json")
+        else:
+            parts = (*self.stack_parts(rec.realm, rec.space, rec.name), "stack.json")
+        self.ms.write_json(rec.to_json(), *parts)
+
+    def read_realm(self, realm: str) -> model.ScopeRecord:
+        d = self.ms.read_json_or(None, *self.realm_parts(realm), "realm.json")
+        if d is None:
+            raise NotFound(f"realm {realm!r} not found")
+        return model.ScopeRecord.from_json(d)
+
+    def read_space(self, realm: str, space: str) -> model.ScopeRecord:
+        d = self.ms.read_json_or(None, *self.space_parts(realm, space), "space.json")
+        if d is None:
+            raise NotFound(f"space {realm}/{space} not found")
+        return model.ScopeRecord.from_json(d)
+
+    def read_stack(self, realm: str, space: str, stack: str) -> model.ScopeRecord:
+        d = self.ms.read_json_or(None, *self.stack_parts(realm, space, stack), "stack.json")
+        if d is None:
+            raise NotFound(f"stack {realm}/{space}/{stack} not found")
+        return model.ScopeRecord.from_json(d)
+
+    def list_realms(self) -> list[str]:
+        return self.ms.list_dirs(consts.REALMS_DIR)
+
+    def list_spaces(self, realm: str) -> list[str]:
+        return self.ms.list_dirs(*self.realm_parts(realm), consts.SPACES_DIR)
+
+    def list_stacks(self, realm: str, space: str) -> list[str]:
+        return self.ms.list_dirs(*self.space_parts(realm, space), consts.STACKS_DIR)
+
+    def list_cells(self, realm: str, space: str, stack: str) -> list[str]:
+        return self.ms.list_dirs(*self.stack_parts(realm, space, stack), consts.CELLS_DIR)
+
+    # --- cell records ------------------------------------------------------
+
+    def write_cell(self, rec: model.CellRecord) -> None:
+        self.ms.write_json(
+            rec.to_json(), *self.cell_parts(rec.realm, rec.space, rec.stack, rec.name), "cell.json"
+        )
+
+    def read_cell(self, realm: str, space: str, stack: str, cell: str) -> model.CellRecord:
+        d = self.ms.read_json_or(None, *self.cell_parts(realm, space, stack, cell), "cell.json")
+        if d is None:
+            raise NotFound(f"cell {realm}/{space}/{stack}/{cell} not found")
+        return model.CellRecord.from_json(d)
+
+    def cell_exists(self, realm: str, space: str, stack: str, cell: str) -> bool:
+        return self.ms.exists(*self.cell_parts(realm, space, stack, cell), "cell.json")
+
+    def delete_cell_tree(self, realm: str, space: str, stack: str, cell: str) -> bool:
+        return self.ms.delete_tree(*self.cell_parts(realm, space, stack, cell))
+
+    # --- scoped resources --------------------------------------------------
+
+    def write_scoped(self, kind_dir: str, realm: str, space: str | None,
+                     stack: str | None, name: str, doc: dict) -> None:
+        self.ms.write_json(doc, *self.scope_parts(realm, space, stack), kind_dir, f"{name}.json")
+
+    def read_scoped(self, kind_dir: str, realm: str, space: str | None,
+                    stack: str | None, name: str) -> dict | None:
+        return self.ms.read_json_or(
+            None, *self.scope_parts(realm, space, stack), kind_dir, f"{name}.json"
+        )
+
+    def resolve_scoped(self, kind_dir: str, realm: str, space: str | None,
+                       stack: str | None, name: str) -> dict | None:
+        """Look up a scoped resource from the innermost scope outward
+        (stack -> space -> realm), the reference's resolution order."""
+        scopes = []
+        if space is not None and stack is not None:
+            scopes.append((realm, space, stack))
+        if space is not None:
+            scopes.append((realm, space, None))
+        scopes.append((realm, None, None))
+        for r, s, st in scopes:
+            d = self.read_scoped(kind_dir, r, s, st, name)
+            if d is not None:
+                return d
+        return None
+
+    def list_scoped(self, kind_dir: str, realm: str, space: str | None = None,
+                    stack: str | None = None) -> list[str]:
+        return [
+            f[: -len(".json")]
+            for f in self.ms.list_files(*self.scope_parts(realm, space, stack), kind_dir)
+        ]
+
+    def delete_scoped(self, kind_dir: str, realm: str, space: str | None,
+                      stack: str | None, name: str) -> bool:
+        return self.ms.delete(*self.scope_parts(realm, space, stack), kind_dir, f"{name}.json")

@@ -66,6 +66,7 @@ class Request:
     sampling: SamplingParams
     emit: Callable[[int, bool], None] | None = None   # (token, done)
     generated: list[int] = dataclasses.field(default_factory=list)
+    error: Exception | None = None
     slot: int = -1
     submitted_at: float = 0.0
     first_token_at: float = 0.0
@@ -124,6 +125,7 @@ class ServingEngine:
         self._lock = threading.Lock()
         self._running = False
         self._thread: threading.Thread | None = None
+        self.error: Exception | None = None   # last engine-loop failure
 
         self._build_programs()
 
@@ -248,6 +250,8 @@ class ServingEngine:
         else:
             while not req.done.is_set():
                 self.step()
+        if req.error is not None:
+            raise RuntimeError(f"generation failed: {req.error}") from req.error
         return req.generated
 
     def warmup(self, prompt_len: int, sampling: SamplingParams | None = None):
@@ -298,8 +302,38 @@ class ServingEngine:
 
     def _loop(self):
         while self._running:
-            if not self.step():
-                time.sleep(0.001)
+            try:
+                if not self.step():
+                    time.sleep(0.001)
+            except Exception as e:  # noqa: BLE001 — the engine thread must not die silently
+                import traceback
+
+                traceback.print_exc()
+                self.error = e
+                self._fail_all(e)
+                # Keep serving: state may be poisoned, so rebuild it.
+                try:
+                    with jax.set_mesh(self.mesh):
+                        self.state = self._init_state()
+                    self._slot_req = [None] * self.num_slots
+                    self._slot_len = [0] * self.num_slots
+                except Exception:  # noqa: BLE001
+                    self._running = False
+                    raise
+
+    def _fail_all(self, exc: Exception):
+        """Fail every active + pending request so callers don't hang."""
+        for slot, req in list(self._active_requests()):
+            req.error = exc
+            self._slot_req[slot] = None
+            req.done.set()
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            req.error = exc
+            req.done.set()
 
     # --- engine core -------------------------------------------------------
 

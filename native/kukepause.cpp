@@ -1,0 +1,33 @@
+// kukepause: minimal pause process serving as every cell's root task.
+//
+// Parity with the reference's cmd/kukepause/main.go:17-62 (a static
+// CGO_ENABLED=0 Go binary there; C++ here): SIGTERM/SIGINT exit 0
+// immediately so cell teardown doesn't eat the 10s SIGKILL escalation that
+// `sleep infinity` (which ignores SIGTERM) forced, and SIGCHLD children are
+// reaped so the cell never accumulates zombies.
+//
+// Build: g++ -O2 -static -o kukepause kukepause.cpp
+
+#include <csignal>
+#include <cstdlib>
+#include <sys/wait.h>
+#include <unistd.h>
+
+int main() {
+    sigset_t set;
+    sigemptyset(&set);
+    sigaddset(&set, SIGTERM);
+    sigaddset(&set, SIGINT);
+    sigaddset(&set, SIGCHLD);
+    sigprocmask(SIG_BLOCK, &set, nullptr);
+
+    for (;;) {
+        int sig = 0;
+        if (sigwait(&set, &sig) != 0) continue;
+        if (sig == SIGTERM || sig == SIGINT) return 0;
+        if (sig == SIGCHLD) {
+            // Reap everything currently reapable.
+            while (waitpid(-1, nullptr, WNOHANG) > 0) {}
+        }
+    }
+}

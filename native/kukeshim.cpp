@@ -1,0 +1,127 @@
+// kukeshim: per-container supervisor for non-attachable workloads.
+//
+// The process-backend analog of the containerd shim + cio.LogFile pair the
+// reference relies on (internal/ctr/container.go, attachable.go:60-75): the
+// daemon must be restartable without losing workloads or their exit codes,
+// so a tiny native supervisor owns each workload:
+//
+//   kukeshim --log FILE --exit-file FILE --pid-file FILE [--cwd DIR]
+//            [--cgroup DIR] -- CMD [ARGS...]
+//
+// - detaches into its own session (survives daemon restart),
+// - writes the workload pid to --pid-file,
+// - redirects workload stdout/stderr to --log,
+// - optionally enters a cgroup (writes its pid to DIR/cgroup.procs before
+//   spawning, so the workload inherits membership),
+// - forwards SIGTERM/SIGINT to the workload (whole process group),
+// - on workload exit writes the exit code to --exit-file (atomic rename).
+//
+// Build: g++ -O2 -o kukeshim kukeshim.cpp
+
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <string>
+#include <sys/stat.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <vector>
+
+static pid_t g_child = -1;
+
+static void forward_signal(int sig) {
+    if (g_child > 0) kill(-g_child, sig);  // whole workload process group
+}
+
+static void write_file_atomic(const std::string& path, const std::string& content) {
+    std::string tmp = path + ".tmp";
+    int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) return;
+    ssize_t unused = write(fd, content.c_str(), content.size());
+    (void)unused;
+    close(fd);
+    rename(tmp.c_str(), path.c_str());
+}
+
+int main(int argc, char** argv) {
+    std::string log_path, exit_path, pid_path, cwd, cgroup_dir;
+    int i = 1;
+    for (; i < argc; i++) {
+        std::string a = argv[i];
+        if (a == "--log" && i + 1 < argc) log_path = argv[++i];
+        else if (a == "--exit-file" && i + 1 < argc) exit_path = argv[++i];
+        else if (a == "--pid-file" && i + 1 < argc) pid_path = argv[++i];
+        else if (a == "--cwd" && i + 1 < argc) cwd = argv[++i];
+        else if (a == "--cgroup" && i + 1 < argc) cgroup_dir = argv[++i];
+        else if (a == "--") { i++; break; }
+        else {
+            fprintf(stderr, "kukeshim: unknown arg %s\n", a.c_str());
+            return 2;
+        }
+    }
+    if (i >= argc) {
+        fprintf(stderr, "kukeshim: no command after --\n");
+        return 2;
+    }
+
+    // Detach from the daemon's session so we survive its restart.
+    if (setsid() < 0 && getpid() != getsid(0)) {
+        // Already a session leader is fine; other errors are not fatal either.
+    }
+    signal(SIGHUP, SIG_IGN);
+
+    if (!cgroup_dir.empty()) {
+        std::string procs = cgroup_dir + "/cgroup.procs";
+        int fd = open(procs.c_str(), O_WRONLY);
+        if (fd >= 0) {
+            std::string pid = std::to_string(getpid());
+            ssize_t unused = write(fd, pid.c_str(), pid.size());
+            (void)unused;
+            close(fd);
+        }
+    }
+
+    g_child = fork();
+    if (g_child < 0) { perror("kukeshim: fork"); return 1; }
+    if (g_child == 0) {
+        // Workload: own process group; logs to file; exec.
+        setpgid(0, 0);
+        if (!cwd.empty() && chdir(cwd.c_str()) != 0) {
+            fprintf(stderr, "kukeshim: chdir %s: %s\n", cwd.c_str(), strerror(errno));
+            _exit(127);
+        }
+        if (!log_path.empty()) {
+            int lfd = open(log_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0640);
+            if (lfd >= 0) {
+                dup2(lfd, STDOUT_FILENO);
+                dup2(lfd, STDERR_FILENO);
+                close(lfd);
+            }
+        }
+        int dn = open("/dev/null", O_RDONLY);
+        if (dn >= 0) { dup2(dn, STDIN_FILENO); close(dn); }
+        execvp(argv[i], &argv[i]);
+        fprintf(stderr, "kukeshim: exec %s: %s\n", argv[i], strerror(errno));
+        _exit(127);
+    }
+
+    setpgid(g_child, g_child);
+    if (!pid_path.empty()) write_file_atomic(pid_path, std::to_string(g_child));
+
+    struct sigaction sa = {};
+    sa.sa_handler = forward_signal;
+    sigaction(SIGTERM, &sa, nullptr);
+    sigaction(SIGINT, &sa, nullptr);
+
+    int status = 0;
+    while (waitpid(g_child, &status, 0) < 0) {
+        if (errno != EINTR) { status = 0; break; }
+    }
+    int code = WIFEXITED(status) ? WEXITSTATUS(status)
+             : WIFSIGNALED(status) ? 128 + WTERMSIG(status) : 1;
+    if (!exit_path.empty()) write_file_atomic(exit_path, std::to_string(code));
+    return code;
+}

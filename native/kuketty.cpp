@@ -1,0 +1,280 @@
+// kuketty: in-container terminal wrapper for attachable workloads.
+//
+// Parity with the reference's cmd/kuketty (main.go:145,176, claimSocketListener
+// :398; a Go binary wrapping the sbsh terminal library there). Role: own the
+// workload's PTY so terminals survive detach/reattach and daemon restarts;
+// terminal bytes flow CLI <-> kuketty directly over a unix socket, never
+// through the daemon RPC (reference design point, attach/attach.go:17-23).
+//
+//   kuketty --socket PATH --capture FILE --exit-file FILE --pid-file FILE
+//           [--cwd DIR] [--cgroup DIR] [--stage CMD]... -- CMD [ARGS...]
+//
+// - creates a PTY, runs `--stage` commands sequentially on it (runOn:create
+//   stages), then execs the workload shell on the PTY slave,
+// - listens on --socket; one attach client at a time (a new client replaces
+//   the old); server->client bytes are raw PTY output,
+// - client->server frames: [1B type][4B BE len][payload]; 'D' = data to the
+//   PTY, 'W' = resize (payload: u16 rows, u16 cols BE),
+// - appends all PTY output to --capture (terminal transcript survives
+//   detach; reference: ctr/attachable.go:60-66),
+// - exit code mirrors the workload's (written to --exit-file).
+//
+// Build: g++ -O2 -o kuketty kuketty.cpp
+
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <pty.h>
+#include <string>
+#include <sys/ioctl.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <termios.h>
+#include <unistd.h>
+#include <vector>
+
+static pid_t g_child = -1;
+static volatile sig_atomic_t g_term = 0;
+static volatile sig_atomic_t g_chld = 0;
+
+static void on_term(int) {
+    g_term = 1;
+    if (g_child > 0) kill(g_child, SIGTERM);
+}
+static void on_chld(int) { g_chld = 1; }
+
+static void write_file_atomic(const std::string& path, const std::string& content) {
+    std::string tmp = path + ".tmp";
+    int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) return;
+    ssize_t unused = write(fd, content.c_str(), content.size());
+    (void)unused;
+    close(fd);
+    rename(tmp.c_str(), path.c_str());
+}
+
+// Client input accumulates in a buffer and frames are parsed as they
+// complete — a client that stalls mid-frame must never block the select
+// loop (the PTY pump, capture, accepts and child-exit handling all share
+// this single thread).
+struct FrameBuf {
+    std::vector<unsigned char> data;
+
+    // Returns true while complete frames were consumed; sets *bad on a
+    // protocol violation (oversized frame).
+    bool drain(int master, bool* bad) {
+        *bad = false;
+        size_t off = 0;
+        while (data.size() - off >= 5) {
+            unsigned type = data[off];
+            size_t len = ((size_t)data[off + 1] << 24) | ((size_t)data[off + 2] << 16) |
+                         ((size_t)data[off + 3] << 8) | (size_t)data[off + 4];
+            if (len > (1u << 20)) { *bad = true; break; }
+            if (data.size() - off - 5 < len) break;   // incomplete frame
+            const unsigned char* payload = data.data() + off + 5;
+            if (type == 'D') {
+                size_t w = 0;
+                while (w < len) {
+                    ssize_t n = write(master, payload + w, len - w);
+                    if (n <= 0) break;
+                    w += (size_t)n;
+                }
+            } else if (type == 'W' && len == 4) {
+                struct winsize nws = {};
+                nws.ws_row = (payload[0] << 8) | payload[1];
+                nws.ws_col = (payload[2] << 8) | payload[3];
+                ioctl(master, TIOCSWINSZ, &nws);
+                if (g_child > 0) kill(g_child, SIGWINCH);
+            }
+            off += 5 + len;
+        }
+        if (off) data.erase(data.begin(), data.begin() + off);
+        return true;
+    }
+};
+
+int main(int argc, char** argv) {
+    std::string sock_path, capture_path, exit_path, pid_path, cwd, cgroup_dir;
+    std::vector<std::string> stages;
+    int i = 1;
+    for (; i < argc; i++) {
+        std::string a = argv[i];
+        if (a == "--socket" && i + 1 < argc) sock_path = argv[++i];
+        else if (a == "--capture" && i + 1 < argc) capture_path = argv[++i];
+        else if (a == "--exit-file" && i + 1 < argc) exit_path = argv[++i];
+        else if (a == "--pid-file" && i + 1 < argc) pid_path = argv[++i];
+        else if (a == "--cwd" && i + 1 < argc) cwd = argv[++i];
+        else if (a == "--cgroup" && i + 1 < argc) cgroup_dir = argv[++i];
+        else if (a == "--stage" && i + 1 < argc) stages.push_back(argv[++i]);
+        else if (a == "--") { i++; break; }
+        else { fprintf(stderr, "kuketty: unknown arg %s\n", a.c_str()); return 2; }
+    }
+    if (i >= argc || sock_path.empty()) {
+        fprintf(stderr, "kuketty: need --socket and a command after --\n");
+        return 2;
+    }
+
+    if (setsid() < 0) { /* already a leader: fine */ }
+    signal(SIGHUP, SIG_IGN);
+
+    if (!cgroup_dir.empty()) {
+        std::string procs = cgroup_dir + "/cgroup.procs";
+        int fd = open(procs.c_str(), O_WRONLY);
+        if (fd >= 0) {
+            std::string pid = std::to_string(getpid());
+            ssize_t unused = write(fd, pid.c_str(), pid.size());
+            (void)unused;
+            close(fd);
+        }
+    }
+
+    // Claim the attach socket (mode 0660; reference claims with mode/GID,
+    // cmd/kuketty/main.go:398).
+    unlink(sock_path.c_str());
+    int ls = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (ls < 0) { perror("kuketty: socket"); return 1; }
+    struct sockaddr_un addr = {};
+    addr.sun_family = AF_UNIX;
+    if (sock_path.size() >= sizeof(addr.sun_path)) {
+        fprintf(stderr, "kuketty: socket path too long (%zu)\n", sock_path.size());
+        return 2;
+    }
+    strncpy(addr.sun_path, sock_path.c_str(), sizeof(addr.sun_path) - 1);
+    if (bind(ls, (struct sockaddr*)&addr, sizeof(addr)) < 0) {
+        perror("kuketty: bind");
+        return 1;
+    }
+    chmod(sock_path.c_str(), 0660);
+    if (listen(ls, 4) < 0) { perror("kuketty: listen"); return 1; }
+
+    // PTY + workload.
+    int master = -1;
+    struct winsize ws = {24, 80, 0, 0};
+    g_child = forkpty(&master, nullptr, nullptr, &ws);
+    if (g_child < 0) { perror("kuketty: forkpty"); return 1; }
+    if (g_child == 0) {
+        if (!cwd.empty() && chdir(cwd.c_str()) != 0) _exit(127);
+        // runOn:create stages, sequentially, visible on the PTY.
+        for (const auto& s : stages) {
+            int rc = system(s.c_str());
+            if (rc != 0) fprintf(stderr, "kuketty: stage failed (%d): %s\n", rc, s.c_str());
+        }
+        execvp(argv[i], &argv[i]);
+        fprintf(stderr, "kuketty: exec %s: %s\n", argv[i], strerror(errno));
+        _exit(127);
+    }
+    if (!pid_path.empty()) write_file_atomic(pid_path, std::to_string(g_child));
+
+    struct sigaction sa = {};
+    sa.sa_handler = on_term;
+    sigaction(SIGTERM, &sa, nullptr);
+    sigaction(SIGINT, &sa, nullptr);
+    sa.sa_handler = on_chld;
+    sa.sa_flags = SA_NOCLDSTOP;
+    sigaction(SIGCHLD, &sa, nullptr);
+
+    int capture_fd = -1;
+    if (!capture_path.empty())
+        capture_fd = open(capture_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0640);
+
+    int client = -1;
+    FrameBuf client_buf;
+    bool child_exited = false;
+    int status = 0;
+    unsigned char buf[4096];
+
+    while (!child_exited || client >= 0) {
+        if (g_chld) {
+            g_chld = 0;
+            pid_t r = waitpid(g_child, &status, WNOHANG);
+            if (r == g_child) child_exited = true;
+        }
+        if (child_exited) break;
+
+        fd_set rfds;
+        FD_ZERO(&rfds);
+        FD_SET(master, &rfds);
+        FD_SET(ls, &rfds);
+        if (client >= 0) FD_SET(client, &rfds);
+        int maxfd = master > ls ? master : ls;
+        if (client > maxfd) maxfd = client;
+        struct timeval tv = {1, 0};
+        int n = select(maxfd + 1, &rfds, nullptr, nullptr, &tv);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            break;
+        }
+
+        if (FD_ISSET(master, &rfds)) {
+            ssize_t r = read(master, buf, sizeof(buf));
+            if (r > 0) {
+                if (capture_fd >= 0) { ssize_t u = write(capture_fd, buf, r); (void)u; }
+                if (client >= 0) {
+                    ssize_t w = write(client, buf, r);
+                    if (w < 0) { close(client); client = -1; }
+                }
+            } else if (r <= 0 && errno != EAGAIN && errno != EINTR) {
+                // PTY closed: workload gone (or exiting).
+            }
+        }
+        if (FD_ISSET(ls, &rfds)) {
+            int c = accept(ls, nullptr, nullptr);
+            if (c >= 0) {
+                if (client >= 0) close(client);   // new attach replaces old
+                fcntl(c, F_SETFL, fcntl(c, F_GETFL, 0) | O_NONBLOCK);
+                client = c;
+                client_buf.data.clear();
+            }
+        }
+        if (client >= 0 && FD_ISSET(client, &rfds)) {
+            unsigned char in[4096];
+            ssize_t r = read(client, in, sizeof(in));
+            if (r == 0 || (r < 0 && errno != EAGAIN && errno != EINTR)) {
+                close(client);
+                client = -1;
+                client_buf.data.clear();
+            } else if (r > 0) {
+                if (client_buf.data.size() + (size_t)r > (2u << 20)) {
+                    close(client);   // runaway unframed garbage
+                    client = -1;
+                    client_buf.data.clear();
+                } else {
+                    client_buf.data.insert(client_buf.data.end(), in, in + r);
+                    bool bad = false;
+                    client_buf.drain(master, &bad);
+                    if (bad) {
+                        close(client);
+                        client = -1;
+                        client_buf.data.clear();
+                    }
+                }
+            }
+        }
+    }
+
+    // Drain any final PTY output into the capture/client.
+    for (;;) {
+        ssize_t r = read(master, buf, sizeof(buf));
+        if (r <= 0) break;
+        if (capture_fd >= 0) { ssize_t u = write(capture_fd, buf, r); (void)u; }
+        if (client >= 0) { ssize_t u = write(client, buf, r); (void)u; }
+    }
+    if (!child_exited) {
+        waitpid(g_child, &status, 0);
+    }
+    if (client >= 0) close(client);
+    if (capture_fd >= 0) close(capture_fd);
+    close(ls);
+    unlink(sock_path.c_str());
+
+    int code = WIFEXITED(status) ? WEXITSTATUS(status)
+             : WIFSIGNALED(status) ? 128 + WTERMSIG(status) : 1;
+    if (!exit_path.empty()) write_file_atomic(exit_path, std::to_string(code));
+    return code;
+}

@@ -2,7 +2,7 @@
 
 Real-TPU numerical/perf validation lives in the verify recipe (the kernel is
 27x faster than the XLA path at S=8192 on v5e); here we check the tiling /
-online-softmax logic exactly in interpret mode.
+online-softmax / position-masking logic exactly in interpret mode.
 """
 
 import jax
@@ -18,38 +18,54 @@ def _fold(x):
     return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
 
 
+def _run(q, k, v, pos, block_q, block_k, kv_pos=None):
+    B, S, H, D = q.shape
+    out = _flash_forward(
+        _fold(q), _fold(k), _fold(v),
+        pos.astype(jnp.int32),
+        (kv_pos if kv_pos is not None else pos).astype(jnp.int32),
+        H, block_q=block_q, block_k=block_k, interpret=True,
+    )
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def _rand(key, B, S, H, D):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (jax.random.normal(kq, (B, S, H, D), jnp.float32),
+            jax.random.normal(kk, (B, S, H, D), jnp.float32),
+            jax.random.normal(kv, (B, S, H, D), jnp.float32))
+
+
 def test_flash_interpret_matches_reference():
     B, S, H, D = 1, 256, 2, 32
-    key = jax.random.key(0)
-    kq, kk, kv = jax.random.split(key, 3)
-    q = jax.random.normal(kq, (B, S, H, D), jnp.float32)
-    k = jax.random.normal(kk, (B, S, H, D), jnp.float32)
-    v = jax.random.normal(kv, (B, S, H, D), jnp.float32)
+    q, k, v = _rand(jax.random.key(0), B, S, H, D)
     pos = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
-
     ref = attention_reference(q, k, v, attention_mask(pos, pos))
-    out = _flash_forward(
-        _fold(q), _fold(k), _fold(v), block_q=128, block_k=128, interpret=True
-    )
-    out = out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    out = _run(q, k, v, pos, 128, 128)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
 def test_flash_uneven_blocks():
     """block_q != block_k exercises the partial-mask predication."""
     B, S, H, D = 1, 256, 1, 32
-    key = jax.random.key(1)
-    kq, kk, kv = jax.random.split(key, 3)
-    q = jax.random.normal(kq, (B, S, H, D), jnp.float32)
-    k = jax.random.normal(kk, (B, S, H, D), jnp.float32)
-    v = jax.random.normal(kv, (B, S, H, D), jnp.float32)
+    q, k, v = _rand(jax.random.key(1), B, S, H, D)
     pos = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
-
     ref = attention_reference(q, k, v, attention_mask(pos, pos))
-    out = _flash_forward(
-        _fold(q), _fold(k), _fold(v), block_q=128, block_k=64, interpret=True
-    )
-    out = out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    out = _run(q, k, v, pos, 128, 64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_offset_positions():
+    """Non-arange positions (sequence continuation offsets) must mask
+    exactly like the reference — the bug class the kernel's position inputs
+    exist to prevent."""
+    B, S, H, D = 2, 256, 2, 32
+    q, k, v = _rand(jax.random.key(2), B, S, H, D)
+    # Per-batch offsets: batch 0 starts at 100, batch 1 at 7.
+    offsets = jnp.array([[100], [7]], jnp.int32)
+    pos = offsets + jnp.arange(S)[None, :]
+    ref = attention_reference(q, k, v, attention_mask(pos, pos))
+    out = _run(q, k, v, pos, 128, 128)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 

@@ -1,0 +1,140 @@
+"""Manifest parsing, validation, normalization, metadata store."""
+
+import pytest
+
+from kukeon_tpu.runtime import consts, model
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.api.wire import from_wire, to_wire
+from kukeon_tpu.runtime.apply import parser, scheme
+from kukeon_tpu.runtime.errors import InvalidArgument
+from kukeon_tpu.runtime.metadata import MetadataStore
+
+CELL_YAML = """
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata:
+  name: agent-1
+  space: proj
+spec:
+  autoDelete: true
+  containers:
+    - name: shell
+      command: ["/bin/sh", "-c", "sleep 5"]
+      env:
+        - {name: FOO, value: bar}
+      restartPolicy: {policy: on-failure, backoffSeconds: 2.0, maxRetries: 3}
+      attachable: true
+      resources: {tpuChips: 2}
+---
+apiVersion: kukeon.io/v1beta1
+kind: Realm
+metadata:
+  name: prod
+"""
+
+
+def test_parse_multi_doc():
+    docs = parser.parse_documents(CELL_YAML)
+    assert [d.kind for d in docs] == ["Cell", "Realm"]
+    cell = docs[0]
+    assert cell.metadata.name == "agent-1"
+    assert cell.spec.auto_delete is True
+    c = cell.spec.containers[0]
+    assert c.command == ["/bin/sh", "-c", "sleep 5"]
+    assert c.restart_policy.policy == "on-failure"
+    assert c.restart_policy.max_retries == 3
+    assert c.resources.tpu_chips == 2
+    assert c.attachable
+
+
+def test_parse_rejects_unknown_field():
+    bad = CELL_YAML.replace("autoDelete", "autoDeleteTypo")
+    with pytest.raises(InvalidArgument, match="autoDeleteTypo"):
+        parser.parse_documents(bad)
+
+
+def test_parse_rejects_bad_kind_and_names():
+    with pytest.raises(InvalidArgument, match="unknown kind"):
+        parser.parse_documents("apiVersion: kukeon.io/v1beta1\nkind: Nope\nmetadata: {name: x}")
+    with pytest.raises(InvalidArgument, match="invalid"):
+        parser.parse_documents(
+            "apiVersion: kukeon.io/v1beta1\nkind: Realm\nmetadata: {name: Bad_Name}"
+        )
+
+
+def test_parse_model_cell():
+    docs = parser.parse_documents("""
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {name: llm}
+spec:
+  model: {model: llama3-8b, chips: 8, port: 9000, numSlots: 16}
+""")
+    assert docs[0].spec.model.chips == 8
+    assert docs[0].spec.model.num_slots == 16
+
+
+def test_scope_rules():
+    with pytest.raises(InvalidArgument, match="not allowed"):
+        parser.parse_documents(
+            "apiVersion: kukeon.io/v1beta1\nkind: Realm\nmetadata: {name: r, space: s}"
+        )
+    with pytest.raises(InvalidArgument, match="stack scope requires space"):
+        parser.parse_documents("""
+apiVersion: kukeon.io/v1beta1
+kind: Secret
+metadata: {name: s, stack: st}
+spec: {data: {K: v}}
+""")
+
+
+def test_normalize_defaults_scope():
+    docs = parser.parse_documents(CELL_YAML)
+    cell = scheme.normalize(docs[0])
+    assert cell.metadata.realm == consts.DEFAULT_REALM
+    assert cell.metadata.space == "proj"
+    assert cell.metadata.stack == consts.DEFAULT_STACK
+
+
+def test_sort_documents_dependency_order():
+    blob = """
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {name: c}
+spec: {containers: [{name: x, command: [sh]}]}
+---
+apiVersion: kukeon.io/v1beta1
+kind: Realm
+metadata: {name: r}
+---
+apiVersion: kukeon.io/v1beta1
+kind: Secret
+metadata: {name: s}
+spec: {data: {K: v}}
+"""
+    docs = parser.sort_documents(parser.parse_documents(blob))
+    assert [d.kind for d in docs] == ["Realm", "Secret", "Cell"]
+    rev = parser.sort_documents(docs, reverse=True)
+    assert [d.kind for d in rev] == ["Cell", "Secret", "Realm"]
+
+
+def test_wire_roundtrip_cell_record():
+    docs = parser.parse_documents(CELL_YAML)
+    rec = model.cell_record_from_doc(scheme.normalize(docs[0]))
+    d = rec.to_json()
+    rec2 = model.CellRecord.from_json(d)
+    assert rec2.name == rec.name
+    assert rec2.spec.containers[0].restart_policy.backoff_seconds == 2.0
+    assert rec2.spec.containers[0].resources.tpu_chips == 2
+
+
+def test_metadata_store(tmp_path):
+    store = MetadataStore(str(tmp_path))
+    store.write_json({"a": 1}, "realms", "default", "realm.json")
+    assert store.read_json("realms", "default", "realm.json") == {"a": 1}
+    assert store.list_dirs("realms") == ["default"]
+    with store.lock("realms", "default"):
+        store.write_json({"a": 2}, "realms", "default", "realm.json")
+    assert store.read_json("realms", "default", "realm.json")["a"] == 2
+    assert store.delete("realms", "default", "realm.json")
+    assert not store.delete("realms", "default", "realm.json")
