@@ -112,6 +112,7 @@ class Controller:
         for st in stacks:
             self.delete_stack(realm, name, st, purge=True)
         self._reclaim_volumes(realm, name, None)
+        self.runner.teardown_space_network(realm, name)
         self.store.ms.delete_tree(*self.store.space_parts(realm, name))
 
     def delete_stack(self, realm: str, space: str, name: str, purge: bool = False) -> None:
@@ -494,6 +495,13 @@ class Controller:
         return self.create_cell(doc)
 
     # --- reconcile (reference: reconcile.go:52-206) ------------------------
+
+    def reconcile_space_networks(self) -> dict[str, dict]:
+        """Re-assert every space's bridge/conflist/egress chain (reference:
+        ReconcileSpaceNetworks, reconcile.go:52-66 — heals reboot flushes)."""
+        if self.runner.netman is None:
+            return {}
+        return self.runner.netman.reconcile_all()
 
     def reconcile_cells(self) -> dict[str, int]:
         counts: dict[str, int] = {}

@@ -37,6 +37,8 @@ PROTOCOL_VERSION = "v1"
 
 
 def build_controller(run_path: str) -> Controller:
+    from kukeon_tpu.runtime.net import NetworkManager
+
     ms = MetadataStore(run_path)
     store = ResourceStore(ms)
     cg = CgroupManager()
@@ -45,6 +47,7 @@ def build_controller(run_path: str) -> Controller:
         ProcessBackend(),
         cgroups=cg if cg.available() else None,
         devices=TPUDeviceManager(ms),
+        netman=NetworkManager(store),
     )
     return Controller(store, runner)
 
@@ -327,6 +330,12 @@ class DaemonServer:
         self._server.rpc_service = RPCService(self.ctl, self)  # type: ignore[attr-defined]
         os.chmod(self.socket_path, 0o660)
 
+        # Boot heal: reboots flush iptables/bridges; re-assert the FORWARD
+        # admission chain + every space network before serving (reference:
+        # server.go:151-196, 307).
+        if self.ctl.runner.netman is not None:
+            self.ctl.runner.netman.install_forward()
+        self.ctl.reconcile_space_networks()
         # Eager reconcile pass: a host restart converges immediately
         # (reference: server.go:226-244).
         self.ctl.reconcile_cells()
@@ -358,6 +367,7 @@ class DaemonServer:
         while not self._shutdown.wait(self.reconcile_interval_s):
             try:
                 self.ctl.reconcile_cells()
+                self.ctl.reconcile_space_networks()
             except Exception:  # noqa: BLE001 — ticker must survive
                 traceback.print_exc()
 

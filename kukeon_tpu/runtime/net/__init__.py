@@ -1,0 +1,63 @@
+"""Space networking: subnets, bridges, egress policy, host firewall, slices.
+
+Capability parity with the reference's internal/cni + internal/netpolicy +
+internal/firewall (SURVEY.md §2.6), redesigned for a TPU-VM host:
+
+- ``subnet``: per-space subnet allocator carving /24s from 10.88.0.0/16;
+  on-disk per-space state is the source of truth (survives daemon restarts).
+- ``bridge``: deterministic ``k-<8hex>`` bridge naming + conflist rendering +
+  idempotent bridge ensure/teardown behind a command-runner seam.
+- ``netpolicy``: pure egress-rule generator (fail-closed per-space chains)
+  + iptables enforcer behind the same seam + a noop enforcer for read-only
+  clients and hosts without iptables.
+- ``firewall``: the global KUKEON-FORWARD ingress-admission chain.
+- ``slice``: TPU pod-slice awareness — worker discovery + the realm-mesh
+  rules that let a default-deny realm span the v5e slice's host NICs
+  (BASELINE north star: "internal/cni + internal/netpolicy become
+  pod-slice-aware").
+- ``manager``: NetworkManager gluing the above to the metadata store; the
+  controller calls it on space ensure/delete and each reconcile tick.
+"""
+
+from kukeon_tpu.runtime.net.runners import CommandRunner, FakeRunner, ShellRunner
+from kukeon_tpu.runtime.net.subnet import SubnetAllocator
+from kukeon_tpu.runtime.net.bridge import BridgeManager, bridge_name
+from kukeon_tpu.runtime.net.netpolicy import (
+    IptablesEnforcer,
+    NoopEnforcer,
+    Policy,
+    ResolvedRule,
+    build_rules,
+    dispatch_rule,
+    resolve_policy,
+)
+from kukeon_tpu.runtime.net.firewall import (
+    FORWARD_CHAIN,
+    ForwardInstaller,
+    admission_rules,
+)
+from kukeon_tpu.runtime.net.slice import SliceTopology, discover_slice, slice_mesh_rules
+from kukeon_tpu.runtime.net.manager import NetworkManager
+
+__all__ = [
+    "BridgeManager",
+    "CommandRunner",
+    "FORWARD_CHAIN",
+    "FakeRunner",
+    "ForwardInstaller",
+    "IptablesEnforcer",
+    "NetworkManager",
+    "NoopEnforcer",
+    "Policy",
+    "ResolvedRule",
+    "ShellRunner",
+    "SliceTopology",
+    "SubnetAllocator",
+    "admission_rules",
+    "bridge_name",
+    "build_rules",
+    "discover_slice",
+    "dispatch_rule",
+    "resolve_policy",
+    "slice_mesh_rules",
+]

@@ -1,0 +1,100 @@
+"""Bridge naming, conflist rendering, and idempotent bridge lifecycle.
+
+Reference: internal/cni (manager.go, bridge.go:32-70, network.go). Bridges
+are named ``k-<8 hex>`` from a hash of realm/space (SafeBridgeName pattern;
+the ``k-+`` iptables wildcard in the firewall admission rules depends on
+this prefix). The conflist is rendered per space and persisted next to the
+space's metadata; bridge creation shells out via the runner seam so tests
+never need root.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from kukeon_tpu.runtime.net.runners import CommandRunner
+from kukeon_tpu.runtime.net.subnet import gateway_ip
+
+BRIDGE_PREFIX = "k-"
+CONFLIST_FILE = "network.conflist"
+
+
+def bridge_name(realm: str, space: str) -> str:
+    """Deterministic ``k-<8 hex>`` interface name (IFNAMSIZ-safe)."""
+    h = hashlib.sha256(f"{realm}/{space}".encode()).hexdigest()[:8]
+    return BRIDGE_PREFIX + h
+
+
+def render_conflist(realm: str, space: str, subnet_cidr: str) -> dict:
+    """CNI-compatible conflist document (bridge + host-local IPAM shape).
+
+    Rendered for interoperability with standard CNI tooling even though the
+    process backend programs the bridge directly; a containerd backend can
+    hand this file to the CNI plugins unchanged.
+    """
+    return {
+        "cniVersion": "1.0.0",
+        "name": f"kukeon-{realm}-{space}",
+        "plugins": [
+            {
+                "type": "bridge",
+                "bridge": bridge_name(realm, space),
+                "isGateway": True,
+                "ipMasq": True,
+                "hairpinMode": True,
+                "ipam": {
+                    "type": "host-local",
+                    "ranges": [[{"subnet": subnet_cidr}]],
+                    "routes": [{"dst": "0.0.0.0/0"}],
+                },
+            },
+            {"type": "portmap", "capabilities": {"portMappings": True}},
+        ],
+    }
+
+
+class BridgeManager:
+    """Create/teardown Linux bridges for spaces, idempotently."""
+
+    def __init__(self, runner: CommandRunner):
+        self.runner = runner
+
+    def available(self) -> bool:
+        return self.runner.available("ip")
+
+    def exists(self, name: str) -> bool:
+        code, _ = self.runner.run(["ip", "link", "show", name])
+        return code == 0
+
+    def ensure(self, realm: str, space: str, subnet_cidr: str) -> str:
+        """Idempotently create the bridge, address it with the subnet's
+        gateway IP, and bring it up. Returns the bridge name."""
+        name = bridge_name(realm, space)
+        if not self.exists(name):
+            self.runner.run(["ip", "link", "add", name, "type", "bridge"])
+        gw = gateway_ip(subnet_cidr)
+        prefix = subnet_cidr.split("/")[1]
+        # addr add is not idempotent; tolerate EEXIST by checking first.
+        code, out = self.runner.run(["ip", "-o", "addr", "show", "dev", name])
+        if code != 0 or f"{gw}/{prefix}" not in out:
+            self.runner.run(["ip", "addr", "add", f"{gw}/{prefix}", "dev", name])
+        self.runner.run(["ip", "link", "set", name, "up"])
+        return name
+
+    def teardown(self, realm: str, space: str) -> None:
+        name = bridge_name(realm, space)
+        if self.exists(name):
+            self.runner.run(["ip", "link", "set", name, "down"])
+            self.runner.run(["ip", "link", "delete", name, "type", "bridge"])
+
+
+def write_conflist(space_dir: str, realm: str, space: str, subnet_cidr: str) -> str:
+    path = os.path.join(space_dir, CONFLIST_FILE)
+    doc = render_conflist(realm, space, subnet_cidr)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, path)
+    return path

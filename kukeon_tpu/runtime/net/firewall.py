@@ -1,0 +1,61 @@
+"""Global KUKEON-FORWARD ingress-admission chain.
+
+Reference: internal/firewall/forward.go:17-130. The host FORWARD policy may
+be DROP (Docker does this); kukeon owns one admission chain that accepts
+(1) established/related return traffic and (2) *external* ingress to kukeon
+bridges. Egress admission deliberately lives per-space (fail-closed) — see
+netpolicy.py. The ``! -i k-+`` scope on the ingress rule keeps inter-bridge
+egress flowing through the per-space chains instead of being admitted here.
+"""
+
+from __future__ import annotations
+
+from kukeon_tpu.runtime.net.bridge import BRIDGE_PREFIX
+from kukeon_tpu.runtime.net.runners import CommandRunner
+
+FORWARD_CHAIN = "KUKEON-FORWARD"
+BRIDGE_MATCH = BRIDGE_PREFIX + "+"      # iptables interface wildcard
+_TAG = "kukeon-forward"
+
+
+def admission_rules() -> list[list[str]]:
+    """Pure, ordered rule list for the admission chain (testable w/o fakes)."""
+    return [
+        ["-A", FORWARD_CHAIN,
+         "-m", "conntrack", "--ctstate", "RELATED,ESTABLISHED",
+         "-m", "comment", "--comment", f"{_TAG}:established",
+         "-j", "ACCEPT"],
+        ["-A", FORWARD_CHAIN,
+         "!", "-i", BRIDGE_MATCH, "-o", BRIDGE_MATCH,
+         "-m", "comment", "--comment", f"{_TAG}:ingress",
+         "-j", "ACCEPT"],
+    ]
+
+
+class ForwardInstaller:
+    """Idempotent installer: ensure chain, populate, ensure FORWARD jump."""
+
+    def __init__(self, runner: CommandRunner):
+        self.runner = runner
+
+    def available(self) -> bool:
+        return self.runner.available("iptables")
+
+    def _ipt(self, *args: str) -> tuple[int, str]:
+        return self.runner.run(["iptables", *args])
+
+    def install(self) -> None:
+        code, _ = self._ipt("-n", "-L", FORWARD_CHAIN)
+        if code != 0:
+            self._ipt("-N", FORWARD_CHAIN)
+        self._ipt("-F", FORWARD_CHAIN)
+        for rule in admission_rules():
+            self._ipt(*rule)
+        code, _ = self._ipt("-C", "FORWARD", "-j", FORWARD_CHAIN)
+        if code != 0:
+            self._ipt("-I", "FORWARD", "1", "-j", FORWARD_CHAIN)
+
+    def uninstall(self) -> None:
+        self._ipt("-D", "FORWARD", "-j", FORWARD_CHAIN)
+        self._ipt("-F", FORWARD_CHAIN)
+        self._ipt("-X", FORWARD_CHAIN)

@@ -1,0 +1,136 @@
+"""NetworkManager: glue between the store and the network primitives.
+
+The controller calls ``ensure_space_network`` on space ensure and
+``reconcile_all`` each tick (reference: ReconcileSpaceNetworks,
+reconcile.go:52-66 — re-assert conflist + bridge + egress chain so a reboot
+that flushed iptables/bridges converges within one interval); the daemon
+calls ``install_forward`` at boot (server.go:151-196).
+
+Enforcement is automatic: live ``ip``/``iptables`` programming happens only
+when the binaries exist and we are root; otherwise the manager still
+allocates subnets, renders conflists, and computes policies (so unit tests
+and non-root dev hosts exercise the full control path) but skips the shell.
+``KUKEON_NET_ENFORCE=0|1`` overrides the autodetection.
+"""
+
+from __future__ import annotations
+
+import os
+
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.api.wire import from_wire
+from kukeon_tpu.runtime.net.bridge import BridgeManager, write_conflist
+from kukeon_tpu.runtime.net.firewall import ForwardInstaller
+from kukeon_tpu.runtime.net.netpolicy import (
+    IptablesEnforcer,
+    NoopEnforcer,
+    resolve_policy,
+)
+from kukeon_tpu.runtime.net.runners import CommandRunner, ShellRunner
+from kukeon_tpu.runtime.net.slice import discover_slice, slice_mesh_rules
+from kukeon_tpu.runtime.net.subnet import SubnetAllocator
+from kukeon_tpu.runtime.store import ResourceStore
+
+
+def _enforcement_enabled(runner: CommandRunner) -> bool:
+    override = os.environ.get("KUKEON_NET_ENFORCE")
+    if override is not None:
+        return override not in ("0", "false", "")
+    return (
+        os.geteuid() == 0
+        and runner.available("ip")
+        and runner.available("iptables")
+    )
+
+
+class NetworkManager:
+    def __init__(self, store: ResourceStore,
+                 runner: CommandRunner | None = None,
+                 subnet_pool: str | None = None,
+                 resolver=None):
+        self.store = store
+        self.runner = runner or ShellRunner()
+        self.subnets = SubnetAllocator(
+            store, parent_cidr=subnet_pool or _pool_from_env()
+        )
+        self.enforcing = _enforcement_enabled(self.runner)
+        self.bridges = BridgeManager(self.runner)
+        self.enforcer = (IptablesEnforcer(self.runner) if self.enforcing
+                         else NoopEnforcer())
+        self.forward = ForwardInstaller(self.runner)
+        self.resolver = resolver
+        self.slice_topology = discover_slice()
+
+    # --- bootstrap ----------------------------------------------------------
+
+    def install_forward(self) -> None:
+        if self.enforcing:
+            self.forward.install()
+
+    # --- per-space ----------------------------------------------------------
+
+    def ensure_space_network(self, realm: str, space: str,
+                             spec: t.SpaceSpec) -> dict:
+        subnet = self.subnets.allocate(realm, space, spec.subnet)
+        space_dir = self.store.ms.ensure_dir(*self.store.space_parts(realm, space))
+        conflist_path = write_conflist(space_dir, realm, space, subnet)
+        # When not enforcing, skip DNS: the resolved IPs would be discarded,
+        # and a dead hostname would stall the reconcile ticker on resolver
+        # timeouts for nothing.
+        resolver = self.resolver if self.enforcing else _null_resolver
+        policy = resolve_policy(realm, space, spec.network, resolver=resolver)
+        policy.allow.extend(
+            slice_mesh_rules(self.slice_topology, resolver=resolver)
+        )
+        bridge = policy.bridge
+        if self.enforcing:
+            bridge = self.bridges.ensure(realm, space, subnet)
+            self.enforcer.apply(policy)
+        return {
+            "subnet": subnet,
+            "bridge": bridge,
+            "conflist": conflist_path,
+            "egressDefault": policy.default,
+            "egressRules": len(policy.allow),
+            "enforcing": self.enforcing,
+        }
+
+    def teardown_space_network(self, realm: str, space: str,
+                               spec: t.SpaceSpec | None = None) -> None:
+        spec = spec or t.SpaceSpec()
+        policy = resolve_policy(realm, space, spec.network,
+                                resolver=self.resolver or (lambda h: []))
+        if self.enforcing:
+            self.enforcer.remove(policy)
+            self.bridges.teardown(realm, space)
+        self.subnets.release(realm, space)
+
+    # --- reconcile ----------------------------------------------------------
+
+    def space_spec(self, realm: str, space: str) -> t.SpaceSpec:
+        rec = self.store.read_space(realm, space)
+        return from_wire(t.SpaceSpec, rec.spec_json or {})
+
+    def reconcile_all(self) -> dict[str, dict]:
+        """Re-assert every space's subnet/conflist/bridge/egress chain."""
+        out: dict[str, dict] = {}
+        for realm in self.store.list_realms():
+            for space in self.store.list_spaces(realm):
+                try:
+                    spec = self.space_spec(realm, space)
+                    out[f"{realm}/{space}"] = self.ensure_space_network(
+                        realm, space, spec
+                    )
+                except Exception as e:  # noqa: BLE001 — one bad space must not stall the tick
+                    out[f"{realm}/{space}"] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+
+def _null_resolver(host: str) -> list[str]:
+    return []
+
+
+def _pool_from_env() -> str:
+    from kukeon_tpu.runtime import consts
+
+    return os.environ.get("KUKEON_POD_SUBNET_CIDR", consts.DEFAULT_SUBNET_POOL)

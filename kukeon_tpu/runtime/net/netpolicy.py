@@ -1,0 +1,261 @@
+"""Space egress policy -> iptables rules.
+
+Reference: internal/netpolicy (policy.go:17-27, rules.go:43-154,
+enforcer.go:34-232, resolver.go:28-74). Design points kept:
+
+- **Pure rule generator** — no I/O — so tests compare rule lists directly.
+- **Fail-closed per-space chains**: the per-space chain terminates every
+  packet itself (ACCEPT or DROP); there is no host-global egress blanket,
+  so a missing chain on a default-deny space means no connectivity, never
+  silent unrestricted egress.
+- **Hostnames resolve at apply time** and re-resolve on every reconcile
+  tick so DNS drift converges within one interval.
+- Chain per space: ``KUKEON-EGRESS-<realm>-<space>`` (truncated+hashed to
+  iptables' 28-char chain-name limit), dispatched from the shared
+  ``KUKEON-EGRESS`` master chain by bridge interface.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import ipaddress
+import logging
+import socket
+from dataclasses import dataclass, field
+
+log = logging.getLogger("kukeon.net")
+
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.net.bridge import bridge_name
+from kukeon_tpu.runtime.net.runners import CommandRunner
+
+MASTER_CHAIN = "KUKEON-EGRESS"
+_CHAIN_MAX = 28  # iptables chain-name limit
+
+
+@dataclass
+class ResolvedRule:
+    """One allowlist entry with hostnames flattened to concrete targets."""
+
+    cidr: str = ""
+    ips: list[str] = field(default_factory=list)
+    ports: list[int] = field(default_factory=list)
+    original_host: str = ""
+
+
+@dataclass
+class Policy:
+    realm: str = ""
+    space: str = ""
+    default: str = "allow"           # allow | deny
+    allow: list[ResolvedRule] = field(default_factory=list)
+
+    @property
+    def bridge(self) -> str:
+        return bridge_name(self.realm, self.space)
+
+    def chain_name(self) -> str:
+        base = f"{MASTER_CHAIN}-{self.realm}-{self.space}"
+        if len(base) <= _CHAIN_MAX:
+            return base
+        h = hashlib.sha256(f"{self.realm}/{self.space}".encode()).hexdigest()[:8]
+        return f"{MASTER_CHAIN}-{h}"
+
+    def comment_tag(self) -> str:
+        return f"kukeon:{self.realm}/{self.space}"
+
+
+def resolve_policy(realm: str, space: str, spec: t.NetworkSpec,
+                   resolver=None) -> Policy:
+    """Flatten a NetworkSpec into a Policy, resolving hostnames NOW.
+
+    ``resolver(host) -> list[str]`` is injectable for tests; default uses
+    getaddrinfo. Unresolvable hosts contribute no targets (the reconcile
+    tick retries), matching the reference's drift-tolerant behavior.
+    """
+    resolver = resolver or _dns_resolve
+    rules = []
+    for r in spec.egress_allow:
+        rr = ResolvedRule(ports=list(r.ports))
+        if r.cidr:
+            rr.cidr = r.cidr
+        elif r.host:
+            rr.ips, rr.original_host = resolve_host(r.host, resolver)
+        rules.append(rr)
+    return Policy(realm=realm, space=space, default=spec.egress_default,
+                  allow=rules)
+
+
+def resolve_host(host: str, resolver=None) -> tuple[list[str], str]:
+    """(ips, original_host): IP literals pass through (original_host "");
+    hostnames resolve via ``resolver`` — empty on failure so the next
+    reconcile tick retries. Shared by egress rules and slice-mesh rules."""
+    try:
+        ipaddress.ip_address(host)
+        return [host], ""
+    except ValueError:
+        pass
+    resolver = resolver or _dns_resolve
+    try:
+        return resolver(host), host
+    except OSError:
+        return [], host
+
+
+def _dns_resolve(host: str) -> list[str]:
+    infos = socket.getaddrinfo(host, None, family=socket.AF_INET)
+    return sorted({i[4][0] for i in infos})
+
+
+# --- pure rule generation ----------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Rule:
+    op: str                  # "-A" | "-I"
+    chain: str
+    args: tuple[str, ...]
+
+    def argv(self) -> list[str]:
+        return [self.op, self.chain, *self.args]
+
+
+def build_rules(p: Policy) -> list[Rule]:
+    """Ordered rules for the per-space chain: established-accept, allowlist
+    accepts, then the terminal ACCEPT/DROP (the chain decides every packet)."""
+    chain = p.chain_name()
+    tag = p.comment_tag()
+    rules = [Rule("-A", chain, (
+        "-m", "conntrack", "--ctstate", "RELATED,ESTABLISHED",
+        "-m", "comment", "--comment", f"{tag}:established",
+        "-j", "ACCEPT",
+    ))]
+    for i, r in enumerate(p.allow):
+        rules.extend(_allow_rules(chain, tag, i, r))
+    terminal = "DROP" if p.default == "deny" else "ACCEPT"
+    rules.append(Rule("-A", chain, (
+        "-m", "comment", "--comment", f"{tag}:default", "-j", terminal,
+    )))
+    return rules
+
+
+def _allow_rules(chain: str, tag: str, idx: int, r: ResolvedRule) -> list[Rule]:
+    targets = [r.cidr] if r.cidr else [f"{ip}/32" for ip in r.ips]
+    label = (f"allow[{idx}]:host={r.original_host}" if r.original_host
+             else f"allow[{idx}]:cidr={r.cidr}")
+    out = []
+    for dst in targets:
+        if not r.ports:
+            out.append(Rule("-A", chain, (
+                "-d", dst, "-m", "comment", "--comment", f"{tag}:{label}",
+                "-j", "ACCEPT",
+            )))
+            continue
+        for port in r.ports:
+            out.append(Rule("-A", chain, (
+                "-d", dst, "-p", "tcp", "--dport", str(port),
+                "-m", "comment", "--comment", f"{tag}:{label}",
+                "-j", "ACCEPT",
+            )))
+    return out
+
+
+def dispatch_rule(p: Policy) -> Rule:
+    """Master-chain entry funneling the space's bridge traffic into its chain."""
+    return Rule("-A", MASTER_CHAIN, (
+        "-i", p.bridge,
+        "-m", "comment", "--comment", f"{p.comment_tag()}:dispatch",
+        "-j", p.chain_name(),
+    ))
+
+
+# --- enforcement -------------------------------------------------------------
+
+
+class Enforcer:
+    def apply(self, p: Policy) -> None:
+        raise NotImplementedError
+
+    def remove(self, p: Policy) -> None:
+        raise NotImplementedError
+
+
+class NoopEnforcer(Enforcer):
+    """For read-only clients and hosts without iptables (reference has the
+    same class for exactly that purpose)."""
+
+    def apply(self, p: Policy) -> None:
+        pass
+
+    def remove(self, p: Policy) -> None:
+        pass
+
+
+def restore_payload(p: Policy) -> str:
+    """iptables-restore snippet that atomically replaces the space's chain.
+
+    With ``iptables-restore --noflush``, only chains declared with a
+    ``:NAME`` line are flushed-and-rebuilt inside one kernel commit — so a
+    default-deny space never has a window where its chain exists without
+    its terminal DROP (the flush-then-append approach leaks egress between
+    the flush and the rebuild on every reconcile tick)."""
+    lines = ["*filter", f":{p.chain_name()} - [0:0]"]
+    for rule in build_rules(p):
+        args = " ".join(_quote(a) for a in rule.args)
+        lines.append(f"{rule.op} {rule.chain} {args}")
+    lines.append("COMMIT")
+    return "\n".join(lines) + "\n"
+
+
+def _quote(arg: str) -> str:
+    return f'"{arg}"' if (" " in arg or arg == "") else arg
+
+
+class IptablesEnforcer(Enforcer):
+    def __init__(self, runner: CommandRunner):
+        self.runner = runner
+
+    def available(self) -> bool:
+        return (self.runner.available("iptables")
+                and self.runner.available("iptables-restore"))
+
+    def _ipt(self, *args: str, ok_codes: tuple[int, ...] = (0,)) -> tuple[int, str]:
+        # -w: wait for the xtables lock instead of failing when Docker or a
+        # concurrent reconcile holds it — a silently skipped -A on a deny
+        # space is fail-open.
+        code, out = self.runner.run(["iptables", "-w", *args])
+        if code not in ok_codes:
+            log.warning("iptables -w %s failed (%d): %s",
+                        " ".join(args), code, out.strip())
+        return code, out
+
+    def _ensure_chain(self, chain: str) -> None:
+        code, _ = self.runner.run(["iptables", "-w", "-n", "-L", chain])
+        if code != 0:
+            self._ipt("-N", chain)
+
+    def apply(self, p: Policy) -> None:
+        """Re-assert the space's chain (atomic replace) + ensure dispatch."""
+        self._ensure_chain(MASTER_CHAIN)
+        code, out = self.runner.run(["iptables-restore", "-w", "--noflush"],
+                                    input=restore_payload(p))
+        if code != 0:
+            log.error("iptables-restore for %s failed (%d): %s",
+                      p.chain_name(), code, out.strip())
+        # Dispatch jump: add only if absent (-C probes; nonzero is expected).
+        d = dispatch_rule(p)
+        code, _ = self.runner.run(["iptables", "-w", "-C", d.chain, *d.args])
+        if code != 0:
+            self._ipt("-A", d.chain, *d.args)
+        # Master chain must be reachable from FORWARD.
+        code, _ = self.runner.run(["iptables", "-w", "-C", "FORWARD",
+                                   "-j", MASTER_CHAIN])
+        if code != 0:
+            self._ipt("-I", "FORWARD", "1", "-j", MASTER_CHAIN)
+
+    def remove(self, p: Policy) -> None:
+        chain = p.chain_name()
+        d = dispatch_rule(p)
+        self._ipt("-D", d.chain, *d.args)
+        self._ipt("-F", chain)
+        self._ipt("-X", chain)

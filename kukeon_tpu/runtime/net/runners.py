@@ -1,0 +1,61 @@
+"""Command-runner seam for everything that shells out (ip, iptables).
+
+The reference isolates iptables/bridge shelling behind CommandRunner/
+BridgeRunner interfaces with fakes (netpolicy/enforcer_test.go:33,
+cni/bridge_test.go:34); same pattern here so unit tests never need root.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+
+
+class CommandRunner:
+    """Runs argv (optionally with stdin payload), returns (exit_code, output)."""
+
+    def run(self, argv: list[str], input: str | None = None) -> tuple[int, str]:
+        raise NotImplementedError
+
+    def available(self, binary: str) -> bool:
+        raise NotImplementedError
+
+
+class ShellRunner(CommandRunner):
+    def run(self, argv: list[str], input: str | None = None) -> tuple[int, str]:
+        try:
+            p = subprocess.run(
+                argv, capture_output=True, text=True, timeout=30, check=False,
+                input=input,
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            return 127, str(e)
+        return p.returncode, (p.stdout or "") + (p.stderr or "")
+
+    def available(self, binary: str) -> bool:
+        return shutil.which(binary) is not None
+
+
+class FakeRunner(CommandRunner):
+    """Records every invocation; scriptable responses by argv prefix."""
+
+    def __init__(self, fail_prefixes: list[list[str]] | None = None,
+                 binaries: set[str] | None = None):
+        self.calls: list[list[str]] = []
+        self.inputs: list[str | None] = []
+        self.fail_prefixes = fail_prefixes or []
+        self.binaries = binaries  # None = everything available
+
+    def run(self, argv: list[str], input: str | None = None) -> tuple[int, str]:
+        self.calls.append(list(argv))
+        self.inputs.append(input)
+        for pfx in self.fail_prefixes:
+            if argv[: len(pfx)] == pfx:
+                return 1, f"fake failure for {pfx}"
+        return 0, ""
+
+    def available(self, binary: str) -> bool:
+        return self.binaries is None or binary in self.binaries
+
+    def calls_for(self, binary: str) -> list[list[str]]:
+        return [c for c in self.calls if c and c[0] == binary]

@@ -1,0 +1,77 @@
+"""TPU pod-slice awareness for space networking.
+
+BASELINE north star: "internal/cni + internal/netpolicy become
+pod-slice-aware so a Realm's default-deny mesh spans a v5e slice over the
+TPU host network". On a multi-host slice (e.g. v5e-16+), each TPU-VM worker
+talks to its peers over the host NICs (DCN): the libtpu runtime gRPC port
+plus the megascale/premapped ports. ICI collectives inside one worker's
+chips never touch the host network and need no rules.
+
+Discovery is env-driven (the TPU runtime exports worker topology into every
+TPU VM) with an injectable fallback, so tests and non-TPU hosts work
+without GCE metadata:
+
+- ``TPU_WORKER_HOSTNAMES`` — comma-separated peer hostnames/IPs
+- ``TPU_WORKER_ID`` — this worker's index
+- ``KUKEON_SLICE_WORKERS`` — operator override (takes precedence)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from kukeon_tpu.runtime.net.netpolicy import ResolvedRule
+
+# Host-network ports the TPU runtime uses between slice workers:
+# 8471: libtpu runtime gRPC; 8476-8480: mesh controller / megascale DCN
+# transfers; 8431-8434: worker health/telemetry. Operators can extend via
+# KUKEON_SLICE_PORTS.
+DEFAULT_SLICE_PORTS = [8471, 8476, 8477, 8478, 8479, 8480, 8431, 8432, 8433, 8434]
+
+
+@dataclass
+class SliceTopology:
+    worker_id: int = 0
+    workers: list[str] = field(default_factory=list)   # hostnames or IPs
+    ports: list[int] = field(default_factory=lambda: list(DEFAULT_SLICE_PORTS))
+
+    @property
+    def multi_host(self) -> bool:
+        return len(self.workers) > 1
+
+    def peers(self) -> list[str]:
+        return [w for i, w in enumerate(self.workers) if i != self.worker_id]
+
+
+def discover_slice(env: dict[str, str] | None = None) -> SliceTopology:
+    env = os.environ if env is None else env
+    workers_s = env.get("KUKEON_SLICE_WORKERS") or env.get("TPU_WORKER_HOSTNAMES", "")
+    workers = [w.strip() for w in workers_s.split(",") if w.strip()]
+    ports_s = env.get("KUKEON_SLICE_PORTS", "")
+    ports = ([int(p) for p in ports_s.split(",") if p.strip()]
+             if ports_s else list(DEFAULT_SLICE_PORTS))
+    try:
+        worker_id = int(env.get("TPU_WORKER_ID", "0"))
+    except ValueError:
+        worker_id = 0
+    return SliceTopology(worker_id=worker_id, workers=workers, ports=ports)
+
+
+def slice_mesh_rules(topo: SliceTopology, resolver=None) -> list[ResolvedRule]:
+    """Egress allowlist entries admitting peer-worker DCN traffic.
+
+    Appended to every space policy of a slice-spanning realm so default-deny
+    spaces keep the TPU runtime's worker-to-worker traffic alive. Hostname
+    peers re-resolve on each reconcile tick (same drift story as user rules).
+    """
+    if not topo.multi_host:
+        return []
+    from kukeon_tpu.runtime.net.netpolicy import resolve_host
+
+    rules = []
+    for peer in topo.peers():
+        ips, original = resolve_host(peer, resolver)
+        rules.append(ResolvedRule(ips=ips, original_host=original,
+                                  ports=list(topo.ports)))
+    return rules

@@ -1,0 +1,143 @@
+"""Per-space subnet allocation from a parent pool.
+
+Reference behavior (internal/cni/subnet.go:66-146): carve /24 chunks from
+10.88.0.0/16; each space's assignment persists as ``network.json`` under the
+space's metadata dir, and the allocator re-scans those files on every
+Allocate so it survives daemon restarts with no separate cache.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import threading
+
+from kukeon_tpu.runtime import consts
+from kukeon_tpu.runtime.errors import FailedPrecondition, InvalidArgument
+from kukeon_tpu.runtime.store import ResourceStore
+
+STATE_VERSION = "v1"
+STATE_FILE = "network.json"
+
+
+class SubnetAllocator:
+    """Hands out per-space subnets; on-disk state is the source of truth."""
+
+    def __init__(self, store: ResourceStore,
+                 parent_cidr: str = consts.DEFAULT_SUBNET_POOL,
+                 prefix_len: int = 24):
+        try:
+            self.parent = ipaddress.ip_network(parent_cidr)
+        except ValueError as e:
+            raise InvalidArgument(f"invalid subnet pool {parent_cidr!r}: {e}") from e
+        if self.parent.version != 4:
+            raise InvalidArgument(f"subnet pool {parent_cidr!r} must be IPv4")
+        if prefix_len <= self.parent.prefixlen or prefix_len > 32:
+            raise InvalidArgument(
+                f"prefix /{prefix_len} must be longer than parent "
+                f"/{self.parent.prefixlen} and at most /32"
+            )
+        self.store = store
+        self.prefix_len = prefix_len
+        self._mu = threading.Lock()
+
+    # --- on-disk state ------------------------------------------------------
+
+    def read_state(self, realm: str, space: str) -> dict | None:
+        return self.store.ms.read_json_or(
+            None, *self.store.space_parts(realm, space), STATE_FILE
+        )
+
+    def _write_state(self, realm: str, space: str, state: dict) -> None:
+        self.store.ms.write_json(
+            state, *self.store.space_parts(realm, space), STATE_FILE
+        )
+
+    def in_use(self) -> dict[str, str]:
+        """subnetCIDR -> "realm/space" for every persisted assignment."""
+        out: dict[str, str] = {}
+        for realm in self.store.list_realms():
+            for space in self.store.list_spaces(realm):
+                st = self.read_state(realm, space)
+                if st and st.get("subnetCIDR"):
+                    out[st["subnetCIDR"]] = f"{realm}/{space}"
+        return out
+
+    # --- allocation ---------------------------------------------------------
+
+    def allocate(self, realm: str, space: str, requested: str | None = None) -> str:
+        """Return the space's subnet CIDR, allocating one if needed.
+
+        A ``requested`` CIDR (Space.spec.subnet) is honored if it is inside
+        the pool and not taken by another space; re-calling with the same
+        request is idempotent.
+        """
+        with self._mu:
+            existing = self.read_state(realm, space)
+            if existing and existing.get("subnetCIDR"):
+                if requested and existing["subnetCIDR"] != requested:
+                    raise FailedPrecondition(
+                        f"space {realm}/{space} already has subnet "
+                        f"{existing['subnetCIDR']}; cannot change to {requested}"
+                    )
+                return existing["subnetCIDR"]
+
+            used = self.in_use()
+            me = f"{realm}/{space}"
+            # Overlap detection must be by network math, not string equality:
+            # a requested CIDR with a different prefix length would otherwise
+            # silently overlap auto-allocated /24s.
+            used_nets = {
+                ipaddress.ip_network(cidr): owner
+                for cidr, owner in used.items()
+            }
+            if requested:
+                net = self._validate_requested(requested)
+                for other, owner in used_nets.items():
+                    if owner != me and net.overlaps(other):
+                        raise FailedPrecondition(
+                            f"subnet {requested} overlaps {other} "
+                            f"(allocated to {owner})"
+                        )
+                chosen = str(net)
+            else:
+                chosen = None
+                for cand in self.parent.subnets(new_prefix=self.prefix_len):
+                    if not any(cand.overlaps(n) for n in used_nets):
+                        chosen = str(cand)
+                        break
+                if chosen is None:
+                    raise FailedPrecondition(
+                        f"subnet pool {self.parent} exhausted "
+                        f"({len(used)} spaces allocated)"
+                    )
+            self._write_state(realm, space, {
+                "version": STATE_VERSION, "subnetCIDR": chosen,
+            })
+            return chosen
+
+    def release(self, realm: str, space: str) -> None:
+        self.store.ms.delete(*self.store.space_parts(realm, space), STATE_FILE)
+
+    def _validate_requested(self, cidr: str):
+        try:
+            net = ipaddress.ip_network(cidr)
+        except ValueError as e:
+            raise InvalidArgument(f"invalid subnet {cidr!r}: {e}") from e
+        if net.version != 4:
+            raise InvalidArgument(f"subnet {cidr!r} must be IPv4")
+        if not net.subnet_of(self.parent):
+            raise InvalidArgument(
+                f"subnet {cidr} is outside the pool {self.parent}"
+            )
+        if net.prefixlen < self.prefix_len:
+            raise InvalidArgument(
+                f"subnet {cidr} is wider than the per-space /"
+                f"{self.prefix_len} carve"
+            )
+        return net
+
+
+def gateway_ip(subnet_cidr: str) -> str:
+    """First usable address of the subnet — the bridge's address."""
+    net = ipaddress.ip_network(subnet_cidr)
+    return str(next(net.hosts()))

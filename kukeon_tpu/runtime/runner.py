@@ -58,12 +58,14 @@ class Runner:
         cgroups: CgroupManager | None = None,
         devices: TPUDeviceManager | None = None,
         options: RunnerOptions | None = None,
+        netman=None,
     ):
         self.store = store
         self.backend = backend
         self.cgroups = cgroups
         self.devices = devices or TPUDeviceManager(store.ms, chips=[])
         self.opts = options or RunnerOptions()
+        self.netman = netman
         self._cell_locks: dict[tuple, threading.Lock] = {}
         self._locks_guard = threading.Lock()
 
@@ -92,12 +94,22 @@ class Runner:
         self.store.ms.ensure_dir(*self.store.space_parts(realm, name))
         existing = self.store.ms.read_json_or(None, *self.store.space_parts(realm, name), "space.json")
         if existing is None or spec is not None:
+            # Provision the network BEFORE persisting the spec: a rejected
+            # subnet change must not leave a stored spec the reconcile loop
+            # can never converge on.
+            if self.netman is not None:
+                self.netman.ensure_space_network(realm, name, spec or t.SpaceSpec())
             rec = model.ScopeRecord(kind="Space", name=name, realm=realm,
                                     labels=labels or {},
                                     spec_json=model.spec_to_json(spec or t.SpaceSpec()))
             self.store.write_scope(rec)
         if self.cgroups:
             self.cgroups.ensure(realm, name)
+
+    def teardown_space_network(self, realm: str, name: str,
+                               spec: t.SpaceSpec | None = None) -> None:
+        if self.netman is not None:
+            self.netman.teardown_space_network(realm, name, spec)
 
     def ensure_stack(self, realm: str, space: str, name: str,
                      spec: t.StackSpec | None = None, labels: dict | None = None) -> None:

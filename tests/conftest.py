@@ -13,6 +13,10 @@ switch.
 
 import os
 
+# Tests must never program real bridges/iptables, even when running as root
+# on a host that has the binaries (the runtime's autodetection would).
+os.environ["KUKEON_NET_ENFORCE"] = "0"
+
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
