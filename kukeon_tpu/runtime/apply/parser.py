@@ -35,6 +35,21 @@ def parse_documents(blob: str, source: str = "<manifest>") -> list[t.Document]:
     return docs
 
 
+def dump_documents(docs: list[t.Document]) -> str:
+    """Documents -> multi-doc YAML blob (the inverse of parse_documents)."""
+    from kukeon_tpu.runtime.api.wire import to_wire
+
+    raw_docs = []
+    for d in docs:
+        raw_docs.append({
+            "apiVersion": d.api_version,
+            "kind": d.kind,
+            "metadata": to_wire(d.metadata),
+            "spec": to_wire(d.spec),
+        })
+    return yaml.safe_dump_all(raw_docs, sort_keys=False)
+
+
 def parse_document(raw: dict, context: str) -> t.Document:
     if not isinstance(raw, dict):
         raise InvalidArgument(f"{context}: document must be a mapping")

@@ -55,6 +55,50 @@ def _print(obj, as_json=False):
 
 # --- verb implementations ----------------------------------------------------
 
+def cmd_team(args):
+    from kukeon_tpu.runtime.teams import TeamHost, team_init
+
+    if args.team_cmd != "init":
+        print(f"unknown team subcommand {args.team_cmd!r}", file=sys.stderr)
+        return 2
+    c = None if args.dry_run else _client(args)
+
+    def apply_fn(blob, team, prune):
+        return c.call("ApplyDocuments", yaml=blob, team=team, prune=prune)
+
+    builder = None
+    if args.build:
+        try:
+            from kukeon_tpu.runtime.images import ImageBuilder, ImageStore
+        except ImportError:
+            print("error: the image builder is not available in this build; "
+                  "run team init without --build", file=sys.stderr)
+            return 1
+        builder = ImageBuilder(ImageStore(_run_path(args)))
+    res = team_init(
+        None if args.dry_run else apply_fn,
+        args.file,
+        host=TeamHost(),
+        dry_run=args.dry_run,
+        build=args.build,
+        builder=builder,
+    )
+    print(f"team {res.project}: source at {res.checkout}")
+    if res.built_images:
+        for img in res.built_images:
+            print(f"  built {img}")
+    if res.secret_names:
+        print(f"  secrets: {', '.join(res.secret_names)}")
+    if args.dry_run and res.rendered:
+        from kukeon_tpu.runtime.apply.parser import dump_documents
+
+        print(dump_documents(res.rendered.blueprints + res.rendered.configs))
+        return 0
+    for r in res.applied:
+        print(f"  {r['kind'].lower()}/{r['name']} ({r['scope']}): {r['action']}")
+    return 0
+
+
 def cmd_version(args):
     del args
     print(f"kuke {__version__} (kukeon-tpu)")
@@ -529,6 +573,13 @@ def build_parser() -> argparse.ArgumentParser:
     sub_add("doctor")
     sub_add("refresh")
 
+    sp = sub_add("team")
+    sp.add_argument("team_cmd", choices=["init"])
+    sp.add_argument("-f", "--file", required=True, help="ProjectTeam manifest")
+    sp.add_argument("--dry-run", action="store_true")
+    sp.add_argument("--build", action="store_true",
+                    help="build catalog images before rendering")
+
     sp = sub_add("purge")
     sp.add_argument("kind")
     sp.add_argument("name")
@@ -562,6 +613,7 @@ HANDLERS = {
     "doctor": cmd_doctor,
     "refresh": cmd_refresh,
     "purge": cmd_purge,
+    "team": cmd_team,
     "uninstall": cmd_uninstall,
 }
 

@@ -415,6 +415,19 @@ class Controller:
                  d.metadata.space, d.metadata.stack, d.metadata.name)
                 for d in (scheme.normalize(x) for x in applied)}
         results = []
+        # Exact identity of each kept config's ONE materialized cell
+        # (cell name defaults to the config name; scope to the config's).
+        kept_config_cells = set()
+        for d in (scheme.normalize(x) for x in applied):
+            if d.kind != t.KIND_CELL_CONFIG:
+                continue
+            md = d.metadata
+            kept_config_cells.add((
+                md.realm or consts.DEFAULT_REALM,
+                md.space or consts.DEFAULT_SPACE,
+                md.stack or consts.DEFAULT_STACK,
+                d.spec.cell_name or md.name,
+            ))
         for realm in self.store.list_realms():
             for rec in self.list_cells(realm):
                 labels = rec.get("labels", {})
@@ -423,24 +436,39 @@ class Controller:
                 key = (t.KIND_CELL, rec["realm"], rec["space"], rec["stack"], rec["name"])
                 if key in keep:
                     continue
+                # A Config-lineage cell lives as long as its config — but
+                # only the config's CURRENT materialization; stale or
+                # renamed materializations fall through and get pruned.
+                ident = (rec["realm"], rec["space"], rec["stack"], rec["name"])
+                if labels.get(consts.LABEL_PROVENANCE_CONFIG) and \
+                        ident in kept_config_cells:
+                    continue
                 self.runner.delete_cell(rec["realm"], rec["space"], rec["stack"],
                                         rec["name"], force=True)
                 results.append(ApplyResult(kind=t.KIND_CELL, name=rec["name"],
                                            scope=f"{rec['realm']}/{rec['space']}/{rec['stack']}",
                                            action="pruned"))
-            # Prune scoped kinds at realm scope (space/stack walk omitted for
-            # brevity; teams apply at realm scope by default).
+            # Prune scoped kinds at every scope level (Config before
+            # Blueprint, then Secret — reference: apply.go:363-445).
+            scopes: list[tuple[str | None, str | None]] = [(None, None)]
+            for space in self.store.list_spaces(realm):
+                scopes.append((space, None))
+                for stack in self.store.list_stacks(realm, space):
+                    scopes.append((space, stack))
             for kind_dir, kind in ((consts.CONFIGS_DIR, t.KIND_CELL_CONFIG),
-                                   (consts.BLUEPRINTS_DIR, t.KIND_CELL_BLUEPRINT)):
-                for name in self.store.list_scoped(kind_dir, realm):
-                    doc = self.store.read_scoped(kind_dir, realm, None, None, name)
-                    if not doc or doc.get("labels", {}).get(consts.LABEL_TEAM) != team:
-                        continue
-                    if (kind, realm, None, None, name) in keep:
-                        continue
-                    self.store.delete_scoped(kind_dir, realm, None, None, name)
-                    results.append(ApplyResult(kind=kind, name=name, scope=realm,
-                                               action="pruned"))
+                                   (consts.BLUEPRINTS_DIR, t.KIND_CELL_BLUEPRINT),
+                                   (consts.SECRETS_DIR, t.KIND_SECRET)):
+                for space, stack in scopes:
+                    for name in self.store.list_scoped(kind_dir, realm, space, stack):
+                        doc = self.store.read_scoped(kind_dir, realm, space, stack, name)
+                        if not doc or doc.get("labels", {}).get(consts.LABEL_TEAM) != team:
+                            continue
+                        if (kind, realm, space, stack, name) in keep:
+                            continue
+                        self.store.delete_scoped(kind_dir, realm, space, stack, name)
+                        scope_str = "/".join(x for x in (realm, space, stack) if x)
+                        results.append(ApplyResult(kind=kind, name=name,
+                                                   scope=scope_str, action="pruned"))
         return results
 
     # --- blueprint/config materialization ----------------------------------
@@ -448,7 +476,12 @@ class Controller:
     def materialize_config(self, realm: str, space: str | None, stack: str | None,
                            config_name: str) -> dict:
         """CellConfig -> live cell (reference: cellconfig/materialize.go)."""
-        cfg = self.get_config(realm, space, stack, config_name)
+        cfg_doc = self.store.resolve_scoped(
+            consts.CONFIGS_DIR, realm, space, stack, config_name
+        )
+        if cfg_doc is None:
+            raise NotFound(f"cellconfig {config_name!r} not found")
+        cfg = from_wire(t.CellConfigSpec, cfg_doc["spec"])
         bp = self.get_blueprint(realm, space, stack, cfg.blueprint)
         cell_spec = substitute_blueprint(bp, cfg.values)
         # Bind config env overlay + secret slots.
@@ -461,13 +494,23 @@ class Controller:
                     if s.name == binding.slot else s
                     for s in c.secrets
                 ]
-        name = cfg.cell_name or naming.random_cell_name(bp.name_prefix or cfg.blueprint)
+        # A config represents exactly ONE live cell, so the default name is
+        # the config's own name — deterministic across applies (a random
+        # name here would mint a fresh cell every apply; fresh-cell-per-run
+        # is run_blueprint's job).
+        name = cfg.cell_name or config_name
         doc = t.Document(
             kind=t.KIND_CELL,
             metadata=t.Metadata(
                 name=name, realm=realm, space=space, stack=stack,
-                labels={consts.LABEL_PROVENANCE_CONFIG: config_name,
-                        consts.LABEL_PROVENANCE_BLUEPRINT: cfg.blueprint},
+                # The cell inherits the config's team label so team prune
+                # converges materialized cells too.
+                labels={
+                    **{k: v for k, v in (cfg_doc.get("labels") or {}).items()
+                       if k == consts.LABEL_TEAM},
+                    consts.LABEL_PROVENANCE_CONFIG: config_name,
+                    consts.LABEL_PROVENANCE_BLUEPRINT: cfg.blueprint,
+                },
             ),
             spec=cell_spec,
         )

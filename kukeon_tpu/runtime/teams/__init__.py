@@ -1,0 +1,49 @@
+"""Teams subsystem: per-project agent-team distribution (`kuke team init`).
+
+Capability parity with the reference's §2.7 packages (SURVEY.md):
+kuketeams.io/v1 doc model + parser (internal/kuketeams), host config
+lifecycle (internal/teamhost), agents-repo source resolution
+(internal/teamsource), two-layer secrets.env (internal/teamsecrets),
+roster rendering to CellBlueprint+CellConfig pairs (internal/teamrender),
+and catalog image builds (internal/teambuild, wired to the image builder).
+
+The pipeline (`kuke team init`):
+  host config -> source clone -> [build images] -> secrets -> render ->
+  apply-with-prune under the `kukeon.io/team` label.
+"""
+
+from kukeon_tpu.runtime.teams.types import (
+    Harness,
+    ImageCatalog,
+    ImageCatalogEntry,
+    ProjectTeam,
+    Role,
+    TeamSource,
+    TeamsConfig,
+    parse_team_documents,
+)
+from kukeon_tpu.runtime.teams.host import TeamHost
+from kukeon_tpu.runtime.teams.source import GitRunner, FakeGitRunner, TeamSourceResolver
+from kukeon_tpu.runtime.teams.secrets import load_team_secrets, secret_documents
+from kukeon_tpu.runtime.teams.render import RenderResult, render_team
+from kukeon_tpu.runtime.teams.init import team_init
+
+__all__ = [
+    "FakeGitRunner",
+    "GitRunner",
+    "Harness",
+    "ImageCatalog",
+    "ImageCatalogEntry",
+    "ProjectTeam",
+    "RenderResult",
+    "Role",
+    "TeamHost",
+    "TeamSource",
+    "TeamSourceResolver",
+    "TeamsConfig",
+    "load_team_secrets",
+    "parse_team_documents",
+    "render_team",
+    "secret_documents",
+    "team_init",
+]

@@ -1,0 +1,125 @@
+"""Host-side teams config lifecycle.
+
+Reference: internal/teamhost (teamhost.go, provision.go). Owns the operator's
+``~/.kuke`` tree:
+
+  ~/.kuke/kuketeams.yaml           global TeamsConfig (operator facts)
+  ~/.kuke/kuketeam.d/<name>.yaml   per-project TeamEntry drop-ins
+  ~/.kuke/teams/secrets.env        host-wide shared secrets
+  ~/.kuke/teams/<project>/secrets.env   per-team overrides
+  ~/.kuke/teams/cache/<repo@ref>/  agents-repo clone cache
+
+``KUKE_HOME`` overrides the base for tests and multi-profile hosts.
+"""
+
+from __future__ import annotations
+
+import os
+
+import yaml
+
+from kukeon_tpu.runtime.errors import InvalidArgument, NotFound
+from kukeon_tpu.runtime.teams import types as tt
+
+GLOBAL_CONFIG = "kuketeams.yaml"
+DROPIN_DIR = "kuketeam.d"
+TEAMS_DIR = "teams"
+CACHE_DIR = "cache"
+SECRETS_ENV = "secrets.env"
+
+_SCAFFOLD = """\
+apiVersion: kuketeams.io/v1
+kind: TeamsConfig
+spec:
+  git:
+    name: ""
+    email: ""
+  registry: ""
+  sources: {}
+  secrets: {}
+"""
+
+
+def kuke_home() -> str:
+    return os.environ.get("KUKE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".kuke"
+    )
+
+
+class TeamHost:
+    def __init__(self, base: str | None = None):
+        self.base = base or kuke_home()
+
+    # --- paths --------------------------------------------------------------
+
+    def config_path(self) -> str:
+        return os.path.join(self.base, GLOBAL_CONFIG)
+
+    def dropin_path(self, project: str) -> str:
+        return os.path.join(self.base, DROPIN_DIR, f"{project}.yaml")
+
+    def shared_secrets_path(self) -> str:
+        return os.path.join(self.base, TEAMS_DIR, SECRETS_ENV)
+
+    def team_secrets_path(self, project: str) -> str:
+        return os.path.join(self.base, TEAMS_DIR, project, SECRETS_ENV)
+
+    def cache_dir(self, source: tt.TeamSource) -> str:
+        return os.path.join(self.base, TEAMS_DIR, CACHE_DIR, source.cache_key())
+
+    # --- config -------------------------------------------------------------
+
+    def load_config(self, scaffold: bool = True) -> tt.TeamsConfig:
+        """Load the global TeamsConfig, scaffolding a minimal one on first
+        use (the reference writes the default O_EXCL so hand edits win)."""
+        path = self.config_path()
+        if not os.path.exists(path):
+            if not scaffold:
+                raise NotFound(f"no teams config at {path}")
+            os.makedirs(self.base, mode=0o700, exist_ok=True)
+            with open(path, "w") as f:
+                f.write(_SCAFFOLD)
+        with open(path) as f:
+            docs = tt.parse_team_documents(f.read(), origin=path)
+        for d in docs:
+            if isinstance(d, tt.TeamsConfig):
+                return d
+        raise InvalidArgument(f"{path} contains no TeamsConfig document")
+
+    def load_dropin(self, project: str) -> tt.TeamEntry | None:
+        path = self.dropin_path(project)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            docs = tt.parse_team_documents(f.read(), origin=path)
+        for d in docs:
+            if isinstance(d, tt.TeamEntry):
+                return d
+        return None
+
+    def write_dropin(self, entry: tt.TeamEntry) -> str:
+        d = os.path.join(self.base, DROPIN_DIR)
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        path = self.dropin_path(entry.name)
+        doc = {
+            "apiVersion": tt.API_VERSION,
+            "kind": tt.KIND_TEAM_ENTRY,
+            "metadata": {"name": entry.name},
+            "spec": {"path": entry.path},
+        }
+        if entry.team_dir:
+            doc["spec"]["teamDir"] = entry.team_dir
+        if entry.source is not None:
+            src: dict = {"repo": entry.source.repo}
+            value, kind = entry.source.ref()
+            src[kind] = value
+            doc["spec"]["source"] = src
+        with open(path, "w") as f:
+            yaml.safe_dump(doc, f, sort_keys=False)
+        return path
+
+    def ensure_team_dirs(self, project: str) -> None:
+        os.makedirs(os.path.join(self.base, TEAMS_DIR, project),
+                    mode=0o700, exist_ok=True)
+        os.makedirs(os.path.join(self.base, TEAMS_DIR, CACHE_DIR),
+                    mode=0o700, exist_ok=True)

@@ -1,0 +1,139 @@
+"""`kuke team init` orchestration: roster -> running fleet.
+
+Reference call stack (SURVEY.md §3.6): teamhost -> teamsource -> [teambuild]
+-> teamsecrets -> teamrender -> ApplyDocumentsForTeam with prune.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from kukeon_tpu.runtime.errors import InvalidArgument
+from kukeon_tpu.runtime.teams import types as tt
+from kukeon_tpu.runtime.teams.host import TeamHost
+from kukeon_tpu.runtime.teams.render import RenderResult, render_team
+from kukeon_tpu.runtime.teams.secrets import load_team_secrets, secret_documents
+from kukeon_tpu.runtime.teams.source import GitRunner, TeamSourceResolver
+from kukeon_tpu.runtime import consts
+
+
+@dataclass
+class TeamInitResult:
+    project: str = ""
+    checkout: str = ""
+    applied: list = field(default_factory=list)   # ApplyResult-like dicts
+    rendered: RenderResult | None = None
+    secret_names: list[str] = field(default_factory=list)
+    built_images: list[str] = field(default_factory=list)
+
+
+def load_project_team(path: str) -> tt.ProjectTeam:
+    with open(path) as f:
+        docs = tt.parse_team_documents(f.read(), origin=path)
+    teams = [d for d in docs if isinstance(d, tt.ProjectTeam)]
+    if len(teams) != 1:
+        raise InvalidArgument(
+            f"{path} must contain exactly one ProjectTeam (got {len(teams)})"
+        )
+    return teams[0]
+
+
+def team_init(apply_fn, project_file: str, host: TeamHost | None = None,
+              git: GitRunner | None = None, dry_run: bool = False,
+              build: bool = False, builder=None) -> TeamInitResult:
+    """The full pipeline.
+
+    ``apply_fn(yaml_blob, team, prune) -> list[dict]`` is the apply
+    transport — an RPC client call or an in-process controller; None is
+    allowed for dry runs.
+    """
+    host = host or TeamHost()
+    team = load_project_team(project_file)
+    cfg = host.load_config()
+    host.ensure_team_dirs(team.name)
+
+    # Drop-in: the host's per-project entry pins the on-host project path
+    # and may override the source.
+    entry = host.load_dropin(team.name)
+    project_path = entry.path if entry else os.path.dirname(
+        os.path.abspath(project_file)
+    )
+    source = entry.source if entry and entry.source else team.source
+
+    resolver = TeamSourceResolver(host, cfg, git=git)
+    checkout = resolver.resolve(source)
+    bundle = resolver.load_bundle(team, checkout)
+
+    result = TeamInitResult(project=team.name, checkout=checkout)
+
+    if build:
+        if builder is None:
+            raise InvalidArgument("--build requires an image builder")
+        result.built_images = build_team_images(
+            builder, bundle, cfg, checkout
+        )
+
+    secret_values = load_team_secrets(host, cfg, team.name)
+    realm = team.realm or consts.DEFAULT_REALM
+    rendered = render_team(
+        team, bundle, cfg,
+        project_path=project_path,
+        project_repo_url=resolver.clone_url(source),
+    )
+    result.rendered = rendered
+
+    # Only ship secrets the rendered fleet actually binds.
+    needed = {n: secret_values[n] for n in rendered.secrets_needed}
+    result.secret_names = sorted(needed)
+    if dry_run or apply_fn is None:
+        return result
+    missing = sorted(n for n, v in needed.items() if not v)
+    if missing:
+        raise InvalidArgument(
+            f"secrets {missing} have no value; fill "
+            f"{host.team_secrets_path(team.name)}"
+        )
+    secret_docs = secret_documents(needed, team.name, realm)
+    docs = secret_docs + rendered.blueprints + rendered.configs
+
+    from kukeon_tpu.runtime.apply.parser import dump_documents
+
+    result.applied = apply_fn(dump_documents(docs), team.name, True)
+    return result
+
+
+def build_team_images(builder, bundle, cfg: tt.TeamsConfig,
+                      checkout: str) -> list[str]:
+    """FROM-order walk over the catalog's build contexts (reference:
+    internal/teambuild — bases before leaves), building each image via the
+    image builder. Returns the tags built."""
+    entries = [e for e in bundle.catalog.images if e.build.context]
+    by_image = {e.image: e for e in entries}
+    built: list[str] = []
+    seen: set[str] = set()
+
+    def visit(entry, chain):
+        if entry.image in seen:
+            return
+        if entry.image in chain:
+            raise InvalidArgument(
+                f"image FROM cycle: {' -> '.join([*chain, entry.image])}"
+            )
+        kukefile = os.path.join(checkout, entry.build.context,
+                                entry.build.dockerfile or "Kukefile")
+        base = builder.base_of(kukefile)
+        if base in by_image:
+            visit(by_image[base], [*chain, entry.image])
+        builder.build(
+            kukefile,
+            context_dir=os.path.join(checkout, entry.build.context),
+            tag=entry.image,
+            build_args={"REGISTRY": cfg.registry} if cfg.registry else {},
+        )
+        seen.add(entry.image)
+        built.append(entry.image)
+
+    for e in entries:
+        visit(e, [])
+    return built

@@ -1,0 +1,264 @@
+"""Roster rendering: ProjectTeam -> (CellBlueprint, CellConfig) pairs.
+
+Reference: internal/teamrender/teamrender.go. One pair per (role x
+harness), via the same five-step pipeline:
+
+1. **needs-merge** — union of role.needs.image and the project's per-role
+   needs.image, deduped + sorted so renders are byte-identical.
+2. **image-select** — first catalog entry whose harness matches and whose
+   capabilities superset the merged needs; a miss names the first unmet
+   capability and hints at building/labeling an image.
+3. **render** — the harness's blueprint template (jinja2; the harness dir
+   is the loader root so sibling partials {% include %} cleanly), executed
+   against a typed dot-context (role/harness/needs/harnesses/operator/
+   project/image/realm/space/stack), yaml-parsed into a CellBlueprint doc.
+4. **bind** — a CellConfig referencing the blueprint, carrying operator
+   facts as values, the project repo fill, and a secret binding for every
+   secret the role declares that the blueprint has a slot for.
+5. **label** — every doc gets labels[kukeon.io/team] = <project> so
+   prune-apply converges this team without touching others.
+
+Pure: reads template files from the materialized source checkout, writes
+nothing, runs nothing.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from kukeon_tpu.runtime import consts
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.apply import parser
+from kukeon_tpu.runtime.errors import InvalidArgument
+from kukeon_tpu.runtime.teams import types as tt
+from kukeon_tpu.runtime.teams.source import SourceBundle
+
+
+@dataclass
+class RenderResult:
+    blueprints: list[t.Document] = field(default_factory=list)
+    configs: list[t.Document] = field(default_factory=list)
+    secrets_needed: list[str] = field(default_factory=list)
+    images_used: list[tt.ImageCatalogEntry] = field(default_factory=list)
+
+
+def merge_needs(role: tt.Role, project_role: tt.ProjectTeamRole) -> list[str]:
+    return sorted(set(role.needs.image) | set(project_role.needs.image))
+
+
+def select_image(catalog: tt.ImageCatalog, harness: str,
+                 needs: list[str]) -> tt.ImageCatalogEntry:
+    best_missing: list[str] | None = None
+    for entry in catalog.images:
+        if entry.harness != harness:
+            continue
+        missing = [n for n in needs if n not in entry.capabilities]
+        if not missing:
+            return entry
+        # Report against the closest candidate so the error names the
+        # capability the operator actually has to add.
+        if best_missing is None or len(missing) < len(best_missing):
+            best_missing = missing
+    if best_missing is not None:
+        raise InvalidArgument(
+            f"no {harness!r} image provides capability {best_missing[0]!r}; "
+            f"build one and add it to harnesses/images.yaml with that "
+            f"capability label"
+        )
+    raise InvalidArgument(
+        f"image catalog has no entries for harness {harness!r}"
+    )
+
+
+def _template_env(harness_dir: str):
+    import jinja2
+
+    return jinja2.Environment(
+        loader=jinja2.FileSystemLoader(harness_dir),
+        undefined=jinja2.StrictUndefined,
+        keep_trailing_newline=True,
+    )
+
+
+def _operator_facts(cfg: tt.TeamsConfig, team: tt.ProjectTeam) -> dict:
+    return {
+        "GIT_NAME": cfg.git.name,
+        "GIT_EMAIL": cfg.git.email,
+        "GIT_SIGNING_KEY": cfg.git.signing_key,
+        "REGISTRY": cfg.registry,
+        "HOME_DIR": cfg.home_dir or os.path.expanduser("~"),
+        "REPO_OWNER": cfg.repo_owner or team.source.owner,
+    }
+
+
+def render_team(team: tt.ProjectTeam, bundle: SourceBundle,
+                cfg: tt.TeamsConfig, project_path: str = "",
+                project_repo_url: str = "") -> RenderResult:
+    realm = team.realm or consts.DEFAULT_REALM
+    space = team.space or consts.DEFAULT_SPACE
+    stack = team.stack or consts.DEFAULT_STACK
+    operator = _operator_facts(cfg, team)
+    result = RenderResult()
+    secrets_needed: set[str] = set()
+
+    for project_role in team.roles:
+        role = bundle.roles[project_role.ref]
+        harness_names = sorted(
+            set(role.harnesses) | set(team.defaults.harnesses)
+        ) or sorted(team.defaults.harnesses)
+        if not harness_names:
+            raise InvalidArgument(
+                f"role {role.name!r} has no harnesses and the project sets "
+                f"no defaults.harnesses"
+            )
+        for hname in harness_names:
+            if hname not in bundle.harnesses:
+                raise InvalidArgument(
+                    f"role {role.name!r} references unknown harness {hname!r}"
+                )
+            harness = bundle.harnesses[hname]
+            needs = merge_needs(role, project_role)
+            image = select_image(bundle.catalog, hname, needs)
+            bp_doc = _render_blueprint(
+                team, role, harness, project_role, image, bundle, operator,
+                realm, space, stack,
+                project_path=project_path, project_repo_url=project_repo_url,
+            )
+            cfg_doc, bound = _bind_config(
+                team, role, harness, bp_doc, cfg, operator,
+                realm, space, stack,
+            )
+            secrets_needed.update(bound)
+            result.blueprints.append(bp_doc)
+            result.configs.append(cfg_doc)
+            result.images_used.append(image)
+
+    result.secrets_needed = sorted(secrets_needed)
+    return result
+
+
+def _render_blueprint(team, role, harness, project_role, image, bundle,
+                      operator, realm, space, stack, project_path,
+                      project_repo_url) -> t.Document:
+    hdir = bundle.harness_dir(harness.name)
+    if not harness.template:
+        raise InvalidArgument(
+            f"harness {harness.name!r} declares no template"
+        )
+    env = _template_env(hdir)
+    try:
+        tmpl = env.get_template(harness.template)
+    except Exception as e:  # jinja2.TemplateNotFound etc.
+        raise InvalidArgument(
+            f"harness {harness.name!r} template {harness.template!r}: {e}"
+        ) from e
+
+    role_harness = role.harnesses.get(harness.name, tt.RoleHarness())
+    ctx = {
+        "role": {"NAME": role.name, "SKILLS": list(role.skills)},
+        "harness": {
+            "NAME": harness.name,
+            "SKILL_PATH": harness.skill_path,
+            "BASE_IMAGE": harness.base_image,
+        },
+        "needs": {
+            "IMAGE": merge_needs(role, project_role),
+            "REPOS": list(role.needs.repos),
+            "MOUNTS": list(role.needs.mounts),
+            "PARAMS": list(role.needs.params),
+            "SECRETS": _role_secret_names(role, harness.name),
+        },
+        "harnesses": {
+            "SETTINGS": role_harness.settings,
+            "SANDBOX": role_harness.sandbox,
+            "APPROVAL": role_harness.approval,
+            "PERMISSIONS": role_harness.permissions,
+            "SECRETS": list(role_harness.secrets),
+        },
+        "operator": operator,
+        "project": {
+            "NAME": team.project_dir or team.name,
+            "TEAM": team.name,
+            "PROJECT_DIR": project_path,
+            "REPO_URL": project_repo_url,
+        },
+        "image": {
+            "REF": image.ref,
+            "IMAGE": image.image,
+            "CAPABILITIES": list(image.capabilities),
+        },
+        "realm": realm,
+        "space": space,
+        "stack": stack,
+    }
+    try:
+        rendered = tmpl.render(**ctx)
+    except Exception as e:
+        raise InvalidArgument(
+            f"rendering {harness.name!r} template for role {role.name!r}: {e}"
+        ) from e
+
+    docs = parser.parse_documents(
+        rendered, source=f"{harness.name}/{harness.template}[{role.name}]"
+    )
+    bps = [d for d in docs if d.kind == t.KIND_CELL_BLUEPRINT]
+    if len(bps) != 1:
+        raise InvalidArgument(
+            f"harness {harness.name!r} template must render exactly one "
+            f"CellBlueprint (got {len(bps)})"
+        )
+    bp = bps[0]
+    bp.metadata.name = f"{team.name}-{role.name}-{harness.name}"
+    bp.metadata.realm = realm
+    bp.metadata.space = None
+    bp.metadata.stack = None
+    bp.metadata.labels[consts.LABEL_TEAM] = team.name
+    return bp
+
+
+def _role_secret_names(role: tt.Role, harness_name: str) -> list[str]:
+    """Per-harness secrets are primary; role-level needs.secrets is the
+    fallback (reference: role.go RoleHarness.Secrets vs RoleNeeds.Secrets)."""
+    rh = role.harnesses.get(harness_name)
+    if rh and rh.secrets:
+        return sorted(set(rh.secrets))
+    return sorted(set(role.needs.secrets))
+
+
+def _bind_config(team, role, harness, bp_doc: t.Document, cfg, operator,
+                 realm, space, stack) -> tuple[t.Document, list[str]]:
+    declared_slots = {
+        ref.name
+        for c in bp_doc.spec.cell.containers
+        for ref in c.secrets
+    }
+    bindings = []
+    bound_names = []
+    for sname in _role_secret_names(role, harness.name):
+        if sname not in cfg.secrets:
+            raise InvalidArgument(
+                f"role {role.name!r} needs secret {sname!r} but the teams "
+                f"config declares no source for it"
+            )
+        if sname in declared_slots:
+            bindings.append(t.ConfigSecretBinding(slot=sname, secret=sname))
+            bound_names.append(sname)
+
+    values = {f"OPERATOR_{k}": v for k, v in operator.items() if v}
+    values["TEAM"] = team.name
+    cfg_doc = t.Document(
+        kind=t.KIND_CELL_CONFIG,
+        metadata=t.Metadata(
+            name=f"{team.name}-{role.name}-{harness.name}",
+            realm=realm, space=space, stack=stack,
+            labels={consts.LABEL_TEAM: team.name},
+        ),
+        spec=t.CellConfigSpec(
+            blueprint=bp_doc.metadata.name,
+            values=values,
+            secrets=bindings,
+            cell_name=f"{team.name}-{role.name}-{harness.name}",
+        ),
+    )
+    return cfg_doc, bound_names

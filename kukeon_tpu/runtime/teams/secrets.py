@@ -1,0 +1,84 @@
+"""Two-layer secrets.env -> Secret documents.
+
+Reference: internal/teamsecrets/teamsecrets.go. Host-wide
+``~/.kuke/teams/secrets.env`` is merged under the per-team
+``~/.kuke/teams/<project>/secrets.env`` (per-team wins). Missing keys that
+the TeamsConfig declares are scaffolded as empty ``KEY=`` lines in a 0600
+file so the operator has an obvious place to fill them. Secret VALUES are
+never logged and never leave this module except inside the produced
+Secret documents.
+"""
+
+from __future__ import annotations
+
+import os
+
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.teams import types as tt
+from kukeon_tpu.runtime.teams.host import TeamHost
+
+
+def parse_env_file(path: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or "=" not in line:
+                continue
+            k, _, v = line.partition("=")
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _scaffold_missing(path: str, wanted: list[str]) -> None:
+    """Append empty KEY= lines for declared-but-absent keys; create the
+    file 0600 if missing. Never touches existing lines."""
+    existing = parse_env_file(path)
+    missing = [k for k in wanted if k not in existing]
+    if not missing and os.path.exists(path):
+        return
+    os.makedirs(os.path.dirname(path), mode=0o700, exist_ok=True)
+    flags = os.O_WRONLY | os.O_CREAT | os.O_APPEND
+    fd = os.open(path, flags, 0o600)
+    try:
+        for k in missing:
+            os.write(fd, f"{k}=\n".encode())
+    finally:
+        os.close(fd)
+
+
+def load_team_secrets(host: TeamHost, cfg: tt.TeamsConfig,
+                      project: str) -> dict[str, str]:
+    """Merged name->value map for every secret the config declares.
+
+    Declared keys without a value anywhere merge as "" — the caller decides
+    whether an empty secret is an error for the roles that need it.
+    """
+    shared = parse_env_file(host.shared_secrets_path())
+    per_team = parse_env_file(host.team_secrets_path(project))
+    wanted = sorted(cfg.secrets)
+    _scaffold_missing(host.team_secrets_path(project),
+                      [cfg.secrets[n].key or n for n in wanted])
+    out: dict[str, str] = {}
+    for name in wanted:
+        key = cfg.secrets[name].key or name
+        out[name] = per_team.get(key, shared.get(key, ""))
+    return out
+
+
+def secret_documents(values: dict[str, str], project: str,
+                     realm: str) -> list[t.Document]:
+    """One kind:Secret per named secret, realm-scoped, team-labeled."""
+    docs = []
+    for name in sorted(values):
+        docs.append(t.Document(
+            kind=t.KIND_SECRET,
+            metadata=t.Metadata(
+                name=name, realm=realm,
+                labels={"kukeon.io/team": project},
+            ),
+            spec=t.SecretSpec(data={"value": values[name]}),
+        ))
+    return docs

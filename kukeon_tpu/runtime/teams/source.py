@@ -1,0 +1,201 @@
+"""Agents-repo source resolution: clone/refresh + document loading.
+
+Reference: internal/teamsource/teamsource.go. Semantics kept:
+
+- pinned tag/commit: the cache clone is made once and reused as-is
+  (reproducible);
+- floating branch: refetched and hard-reset to the branch tip on every
+  init, so a stale roster is never silently reused;
+- default transport is SSH (``git@<host>:<owner>/<repo>.git``);
+  TeamsConfig.spec.sources overrides per-repo (HTTPS, mirrors, or a local
+  path — which is also how tests provide a fixture remote).
+
+Agents-repo layout (same convention as the reference so existing agents
+repos work unchanged):
+
+  <repo>/<role>/role.yaml
+  <repo>/harnesses/<name>/harness.yaml   (+ template files alongside)
+  <repo>/harnesses/images.yaml
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+from kukeon_tpu.runtime.errors import InvalidArgument, NotFound
+from kukeon_tpu.runtime.teams import types as tt
+from kukeon_tpu.runtime.teams.host import TeamHost
+
+
+class GitRunner:
+    """Shell-out seam so source resolution is unit-testable without git."""
+
+    def run(self, argv: list[str], cwd: str | None = None,
+            env: dict | None = None) -> tuple[int, str]:
+        full_env = dict(os.environ)
+        if env:
+            full_env.update(env)
+        try:
+            p = subprocess.run(["git", *argv], cwd=cwd, env=full_env,
+                               capture_output=True, text=True, timeout=300,
+                               check=False)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            return 127, str(e)
+        return p.returncode, (p.stdout or "") + (p.stderr or "")
+
+
+class FakeGitRunner(GitRunner):
+    """Records calls; 'clone' materializes a scripted directory tree."""
+
+    def __init__(self, tree: dict[str, str] | None = None):
+        self.calls: list[list[str]] = []
+        self.tree = tree or {}
+
+    def run(self, argv, cwd=None, env=None):
+        self.calls.append(list(argv))
+        if argv and argv[0] == "clone":
+            dest = argv[-1]
+            for rel, content in self.tree.items():
+                path = os.path.join(dest, rel)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(path, "w") as f:
+                    f.write(content)
+        return 0, ""
+
+
+class TeamSourceResolver:
+    def __init__(self, host: TeamHost, cfg: tt.TeamsConfig,
+                 git: GitRunner | None = None):
+        self.host = host
+        self.cfg = cfg
+        self.git = git or GitRunner()
+
+    # --- clone/refresh ------------------------------------------------------
+
+    def clone_url(self, source: tt.TeamSource) -> str:
+        qualified = source.qualified_repo()
+        bare = "/".join(qualified.split("/")[1:])   # owner/repo
+        for key in (qualified, bare):
+            if key in self.cfg.sources:
+                return self.cfg.sources[key]
+        return source.default_clone_url()
+
+    def _git_env(self) -> dict:
+        env = {}
+        if self.cfg.git.ssh_key:
+            env["GIT_SSH_COMMAND"] = (
+                f"ssh -i {self.cfg.git.ssh_key} -o IdentitiesOnly=yes"
+            )
+        return env
+
+    def resolve(self, source: tt.TeamSource) -> str:
+        """Return a local checkout dir for the source, cloning/refreshing
+        per the pinned-vs-floating contract."""
+        value, kind = source.ref()
+        cache = self.host.cache_dir(source)
+        env = self._git_env()
+        url = self.clone_url(source)
+
+        if os.path.isdir(os.path.join(cache, ".git")) or (
+            os.path.isdir(cache) and os.listdir(cache)
+        ):
+            if kind == "branch":
+                code, out = self.git.run(["fetch", "origin", value], cwd=cache, env=env)
+                if code != 0:
+                    raise InvalidArgument(
+                        f"refetch of {url} branch {value} failed: {out.strip()}"
+                    )
+                self.git.run(["checkout", value], cwd=cache, env=env)
+                self.git.run(["reset", "--hard", f"origin/{value}"], cwd=cache, env=env)
+            return cache
+
+        os.makedirs(os.path.dirname(cache), exist_ok=True)
+        argv = ["clone"]
+        if kind in ("tag", "branch"):
+            argv += ["--depth", "1", "--branch", value]
+        argv += [url, cache]
+        code, out = self.git.run(argv, env=env)
+        if code != 0:
+            raise InvalidArgument(f"clone of {url} failed: {out.strip()}")
+        if kind == "commit":
+            code, out = self.git.run(["checkout", value], cwd=cache, env=env)
+            if code != 0:
+                raise InvalidArgument(
+                    f"checkout of commit {value} failed: {out.strip()}"
+                )
+        return cache
+
+    # --- document loading ---------------------------------------------------
+
+    def load_bundle(self, team: tt.ProjectTeam, checkout: str) -> "SourceBundle":
+        roles: dict[str, tt.Role] = {}
+        for r in team.roles:
+            roles[r.ref] = load_role(checkout, r.ref)
+        harness_names = set(team.defaults.harnesses)
+        for role in roles.values():
+            harness_names.update(role.harnesses)
+        if not harness_names:
+            raise InvalidArgument(
+                f"team {team.name!r}: no harnesses (set defaults.harnesses "
+                f"or per-role harnesses)"
+            )
+        harnesses = {h: load_harness(checkout, h) for h in sorted(harness_names)}
+        return SourceBundle(
+            checkout=checkout, roles=roles, harnesses=harnesses,
+            catalog=load_image_catalog(checkout),
+        )
+
+
+class SourceBundle:
+    def __init__(self, checkout: str, roles: dict, harnesses: dict, catalog):
+        self.checkout = checkout
+        self.roles = roles
+        self.harnesses = harnesses
+        self.catalog = catalog
+
+    def harness_dir(self, name: str) -> str:
+        return harness_dir(self.checkout, name)
+
+
+# --- layout helpers ----------------------------------------------------------
+
+
+def role_path(checkout: str, ref: str) -> str:
+    return os.path.join(checkout, ref, "role.yaml")
+
+
+def harness_dir(checkout: str, name: str) -> str:
+    return os.path.join(checkout, "harnesses", name)
+
+
+def harness_path(checkout: str, name: str) -> str:
+    return os.path.join(harness_dir(checkout, name), "harness.yaml")
+
+
+def catalog_path(checkout: str) -> str:
+    return os.path.join(checkout, "harnesses", "images.yaml")
+
+
+def _load_one(path: str, want_type, what: str):
+    if not os.path.exists(path):
+        raise NotFound(f"{what}: {path} not found in agents source")
+    with open(path) as f:
+        docs = tt.parse_team_documents(f.read(), origin=path)
+    for d in docs:
+        if isinstance(d, want_type):
+            return d
+    raise InvalidArgument(f"{path} contains no {what} document")
+
+
+def load_role(checkout: str, ref: str) -> tt.Role:
+    return _load_one(role_path(checkout, ref), tt.Role, f"role {ref!r}")
+
+
+def load_harness(checkout: str, name: str) -> tt.Harness:
+    return _load_one(harness_path(checkout, name), tt.Harness,
+                     f"harness {name!r}")
+
+
+def load_image_catalog(checkout: str) -> tt.ImageCatalog:
+    return _load_one(catalog_path(checkout), tt.ImageCatalog, "image catalog")

@@ -1,0 +1,359 @@
+"""kuketeams.io/v1 document model + parser.
+
+Reference: pkg/api/model/kuketeams (projectteam.go, teamsconfig.go, role.go,
+harness.go, imagecatalog.go, source.go) and internal/kuketeams/parser.go.
+Six kinds: ProjectTeam (the per-project roster), TeamsConfig (operator
+facts), TeamEntry (host drop-in), Role, Harness, ImageCatalog (the latter
+three live in the agents source repo).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import yaml
+
+from kukeon_tpu.runtime.errors import InvalidArgument
+
+API_VERSION = "kuketeams.io/v1"
+
+KIND_PROJECT_TEAM = "ProjectTeam"
+KIND_TEAMS_CONFIG = "TeamsConfig"
+KIND_TEAM_ENTRY = "TeamEntry"
+KIND_ROLE = "Role"
+KIND_HARNESS = "Harness"
+KIND_IMAGE_CATALOG = "ImageCatalog"
+
+DEFAULT_SOURCE_HOST = "github.com"
+
+
+@dataclass
+class TeamSource:
+    """Agents-repo reference: host-qualified repo + exactly one of
+    tag (pinned, clone-once) / branch (floating, refetch+reset) /
+    commit (pinned)."""
+
+    repo: str = ""
+    tag: str = ""
+    branch: str = ""
+    commit: str = ""
+
+    def ref(self) -> tuple[str, str]:
+        """(value, kind) — exactly one ref must be set."""
+        set_refs = [(v.strip(), k) for v, k in
+                    ((self.tag, "tag"), (self.branch, "branch"),
+                     (self.commit, "commit")) if v.strip()]
+        if len(set_refs) != 1:
+            raise InvalidArgument(
+                f"source {self.repo!r} must set exactly one of "
+                f"tag/branch/commit (got {len(set_refs)})"
+            )
+        return set_refs[0]
+
+    @property
+    def floating(self) -> bool:
+        return self.ref()[1] == "branch"
+
+    def qualified_repo(self) -> str:
+        """host/owner/repo — a bare owner/repo defaults to github.com."""
+        repo = self.repo.strip().strip("/")
+        if not repo:
+            raise InvalidArgument("source.repo is required")
+        parts = repo.split("/")
+        if len(parts) == 2:
+            return f"{DEFAULT_SOURCE_HOST}/{repo}"
+        if len(parts) == 3:
+            return repo
+        raise InvalidArgument(
+            f"source.repo {self.repo!r} must be <owner>/<repo> or "
+            f"<host>/<owner>/<repo>"
+        )
+
+    @property
+    def owner(self) -> str:
+        return self.qualified_repo().split("/")[1]
+
+    def cache_key(self) -> str:
+        value, _ = self.ref()
+        return f"{self.qualified_repo()}@{value}".replace("/", "_")
+
+    def default_clone_url(self) -> str:
+        host, owner, repo = self.qualified_repo().split("/")
+        return f"git@{host}:{owner}/{repo}.git"
+
+
+@dataclass
+class ProjectRoleNeeds:
+    image: list[str] = field(default_factory=list)   # capability names
+
+
+@dataclass
+class ProjectTeamRole:
+    ref: str = ""
+    needs: ProjectRoleNeeds = field(default_factory=ProjectRoleNeeds)
+
+
+@dataclass
+class ProjectTeamDefaults:
+    harnesses: list[str] = field(default_factory=list)
+
+
+@dataclass
+class ProjectTeam:
+    name: str = ""
+    source: TeamSource = field(default_factory=TeamSource)
+    project_dir: str = ""            # in-cell clone dir override
+    realm: str = ""
+    space: str = ""
+    stack: str = ""
+    defaults: ProjectTeamDefaults = field(default_factory=ProjectTeamDefaults)
+    roles: list[ProjectTeamRole] = field(default_factory=list)
+
+
+@dataclass
+class TeamsConfigGit:
+    name: str = ""
+    email: str = ""
+    signing_key: str = ""
+    ssh_key: str = ""
+
+
+@dataclass
+class TeamsConfigSecret:
+    source: str = ""                 # "from": env-file basename or "env"
+    key: str = ""
+
+
+@dataclass
+class TeamsConfig:
+    git: TeamsConfigGit = field(default_factory=TeamsConfigGit)
+    registry: str = ""
+    home_dir: str = ""
+    repo_owner: str = ""
+    sources: dict[str, str] = field(default_factory=dict)   # repo -> clone URL
+    secrets: dict[str, TeamsConfigSecret] = field(default_factory=dict)
+
+
+@dataclass
+class TeamEntry:
+    name: str = ""
+    path: str = ""                   # on-host project source tree
+    team_dir: str = ""
+    source: TeamSource | None = None
+
+
+@dataclass
+class RoleHarness:
+    settings: str = ""
+    sandbox: str = ""
+    approval: str = ""
+    permissions: str = ""
+    secrets: list[str] = field(default_factory=list)
+
+
+@dataclass
+class RoleNeeds:
+    image: list[str] = field(default_factory=list)
+    repos: list[str] = field(default_factory=list)
+    mounts: list[str] = field(default_factory=list)
+    params: list[str] = field(default_factory=list)
+    secrets: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Role:
+    name: str = ""
+    skills: list[str] = field(default_factory=list)
+    harnesses: dict[str, RoleHarness] = field(default_factory=dict)
+    needs: RoleNeeds = field(default_factory=RoleNeeds)
+
+
+@dataclass
+class HarnessSeed:
+    path: str = ""
+    mode: int = 0
+    content: str = ""
+
+
+@dataclass
+class Harness:
+    name: str = ""
+    base_image: str = ""
+    skill_path: str = ""
+    template: str = ""               # blueprint template file, harness-dir relative
+    seeds: list[HarnessSeed] = field(default_factory=list)
+
+
+@dataclass
+class ImageCatalogBuild:
+    context: str = ""
+    dockerfile: str = ""
+
+
+@dataclass
+class ImageCatalogEntry:
+    ref: str = ""
+    harness: str = ""
+    image: str = ""
+    build: ImageCatalogBuild = field(default_factory=ImageCatalogBuild)
+    capabilities: list[str] = field(default_factory=list)
+
+
+@dataclass
+class ImageCatalog:
+    images: list[ImageCatalogEntry] = field(default_factory=list)
+
+
+# --- parsing -----------------------------------------------------------------
+
+
+def parse_team_documents(blob: str, origin: str = "<inline>") -> list:
+    """Parse a multi-doc YAML blob into typed kuketeams objects."""
+    out = []
+    for i, raw in enumerate(yaml.safe_load_all(blob)):
+        if raw is None:
+            continue
+        if not isinstance(raw, dict):
+            raise InvalidArgument(f"{origin}[{i}]: document must be a mapping")
+        out.append(parse_team_document(raw, f"{origin}[{i}]"))
+    return out
+
+
+def parse_team_document(raw: dict, origin: str = "<inline>"):
+    api = raw.get("apiVersion", "")
+    if api != API_VERSION:
+        raise InvalidArgument(
+            f"{origin}: apiVersion {api!r} is not {API_VERSION}"
+        )
+    kind = raw.get("kind", "")
+    md = raw.get("metadata") or {}
+    spec = raw.get("spec") or {}
+    name = md.get("name", "")
+    if kind == KIND_PROJECT_TEAM:
+        return _parse_project_team(name, spec, origin)
+    if kind == KIND_TEAMS_CONFIG:
+        return _parse_teams_config(spec, origin)
+    if kind == KIND_TEAM_ENTRY:
+        return TeamEntry(
+            name=name, path=spec.get("path", ""),
+            team_dir=spec.get("teamDir", ""),
+            source=_parse_source(spec["source"]) if spec.get("source") else None,
+        )
+    if kind == KIND_ROLE:
+        return _parse_role(name, spec)
+    if kind == KIND_HARNESS:
+        return Harness(
+            name=name,
+            base_image=spec.get("baseImage", ""),
+            skill_path=spec.get("skillPath", ""),
+            template=spec.get("template", ""),
+            seeds=[HarnessSeed(path=s.get("path", ""), mode=s.get("mode", 0),
+                               content=s.get("content", ""))
+                   for s in spec.get("seeds") or []],
+        )
+    if kind == KIND_IMAGE_CATALOG:
+        return ImageCatalog(images=[
+            ImageCatalogEntry(
+                ref=e.get("ref", ""), harness=e.get("harness", ""),
+                image=e.get("image", ""),
+                build=ImageCatalogBuild(
+                    context=(e.get("build") or {}).get("context", ""),
+                    dockerfile=(e.get("build") or {}).get("dockerfile", ""),
+                ),
+                capabilities=list(e.get("capabilities") or []),
+            )
+            for e in spec.get("images") or []
+        ])
+    raise InvalidArgument(f"{origin}: unknown kuketeams kind {kind!r}")
+
+
+def _parse_source(raw) -> TeamSource:
+    if isinstance(raw, str):
+        raise InvalidArgument(
+            f"source {raw!r}: the string form is not supported; use the "
+            f"structured form {{repo, tag|branch|commit}}"
+        )
+    src = TeamSource(repo=raw.get("repo", ""), tag=raw.get("tag", ""),
+                     branch=raw.get("branch", ""), commit=raw.get("commit", ""))
+    src.ref()            # validates exactly-one
+    src.qualified_repo()  # validates shape
+    return src
+
+
+def _parse_project_team(name: str, spec: dict, origin: str) -> ProjectTeam:
+    if not name:
+        raise InvalidArgument(f"{origin}: ProjectTeam needs metadata.name")
+    if not spec.get("source"):
+        raise InvalidArgument(f"{origin}: ProjectTeam needs spec.source")
+    roles = []
+    for r in spec.get("roles") or []:
+        if not r.get("ref"):
+            raise InvalidArgument(f"{origin}: every role needs a ref")
+        needs = r.get("needs") or {}
+        roles.append(ProjectTeamRole(
+            ref=r["ref"],
+            needs=ProjectRoleNeeds(image=list(needs.get("image") or [])),
+        ))
+    if not roles:
+        raise InvalidArgument(f"{origin}: ProjectTeam needs at least one role")
+    defaults = spec.get("defaults") or {}
+    return ProjectTeam(
+        name=name,
+        source=_parse_source(spec["source"]),
+        project_dir=spec.get("projectDir", ""),
+        realm=spec.get("realm", ""),
+        space=spec.get("space", ""),
+        stack=spec.get("stack", ""),
+        defaults=ProjectTeamDefaults(
+            harnesses=list(defaults.get("harnesses") or [])
+        ),
+        roles=roles,
+    )
+
+
+def _parse_teams_config(spec: dict, origin: str) -> TeamsConfig:
+    git = spec.get("git") or {}
+    secrets = {}
+    for sname, s in (spec.get("secrets") or {}).items():
+        if not isinstance(s, dict) or not s.get("from"):
+            raise InvalidArgument(
+                f"{origin}: secret {sname!r} needs a 'from' declaration "
+                f"(secrets never carry inline values)"
+            )
+        secrets[sname] = TeamsConfigSecret(source=s["from"], key=s.get("key", sname))
+    return TeamsConfig(
+        git=TeamsConfigGit(
+            name=git.get("name", ""), email=git.get("email", ""),
+            signing_key=git.get("signingKey", ""),
+            ssh_key=git.get("sshKey", ""),
+        ),
+        registry=spec.get("registry", ""),
+        home_dir=spec.get("homeDir", ""),
+        repo_owner=spec.get("repoOwner", ""),
+        sources=dict(spec.get("sources") or {}),
+        secrets=secrets,
+    )
+
+
+def _parse_role(name: str, spec: dict) -> Role:
+    needs = spec.get("needs") or {}
+    harnesses = {}
+    for hname, h in (spec.get("harnesses") or {}).items():
+        h = h or {}
+        harnesses[hname] = RoleHarness(
+            settings=h.get("settings", ""), sandbox=h.get("sandbox", ""),
+            approval=h.get("approval", ""), permissions=h.get("permissions", ""),
+            secrets=list(h.get("secrets") or []),
+        )
+    return Role(
+        name=name,
+        skills=list(spec.get("skills") or []),
+        harnesses=harnesses,
+        needs=RoleNeeds(
+            image=list(needs.get("image") or []),
+            repos=list(needs.get("repos") or []),
+            mounts=list(needs.get("mounts") or []),
+            params=list(needs.get("params") or []),
+            secrets=list(needs.get("secrets") or []),
+        ),
+    )

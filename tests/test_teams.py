@@ -1,0 +1,433 @@
+"""Teams subsystem: types, host config, source resolution, secrets, render,
+and the full `team init` pipeline against an in-process controller.
+
+The agents-source fixture is a REAL local git repo (git is a hard dependency
+of the subsystem, same as the reference), reached via the TeamsConfig
+sources transport override — no network.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+from kukeon_tpu.runtime import consts
+from kukeon_tpu.runtime.cells.fake import FakeBackend
+from kukeon_tpu.runtime.controller import Controller
+from kukeon_tpu.runtime.errors import InvalidArgument
+from kukeon_tpu.runtime.metadata import MetadataStore
+from kukeon_tpu.runtime.runner import Runner
+from kukeon_tpu.runtime.store import ResourceStore
+from kukeon_tpu.runtime.teams import (
+    TeamHost,
+    TeamSource,
+    TeamSourceResolver,
+    load_team_secrets,
+    parse_team_documents,
+    render_team,
+    secret_documents,
+    team_init,
+)
+from kukeon_tpu.runtime.teams import types as tt
+from kukeon_tpu.runtime.teams.init import load_project_team
+
+
+ROLE_YAML = """\
+apiVersion: kuketeams.io/v1
+kind: Role
+metadata:
+  name: coder
+spec:
+  skills: [git, python]
+  harnesses:
+    claude:
+      settings: settings.json
+      secrets: [api-key]
+  needs:
+    image: [python]
+    secrets: [api-key]
+"""
+
+HARNESS_YAML = """\
+apiVersion: kuketeams.io/v1
+kind: Harness
+metadata:
+  name: claude
+spec:
+  skillPath: /opt/skills
+  makeTarget: claude-image
+  template: blueprint.yaml.j2
+"""
+
+TEMPLATE = """\
+apiVersion: kukeon.io/v1beta1
+kind: CellBlueprint
+metadata:
+  name: rendered
+spec:
+  params:
+    - name: PROMPT
+      default: "you are {{ role.NAME }}"
+  cell:
+    containers:
+      - name: agent
+        command: ["/bin/sh", "-c", "echo {{ role.NAME }}@{{ image.IMAGE }}"]
+        env:
+          - name: GIT_AUTHOR_NAME
+            value: "{{ operator.GIT_NAME }}"
+          - name: SKILLS
+            value: "{{ role.SKILLS | join(',') }}"
+        secrets:
+          - name: api-key
+            env: API_KEY
+        attachable: false
+"""
+
+IMAGES_YAML = """\
+apiVersion: kuketeams.io/v1
+kind: ImageCatalog
+spec:
+  images:
+    - ref: claude-basic
+      harness: claude
+      image: kukeon.internal/claude-basic:v1
+      build: {context: images/basic, dockerfile: Kukefile}
+      capabilities: [git]
+    - ref: claude-py
+      harness: claude
+      image: kukeon.internal/claude-py:v1
+      build: {context: images/py, dockerfile: Kukefile}
+      capabilities: [git, python]
+"""
+
+PROJECT_YAML = """\
+apiVersion: kuketeams.io/v1
+kind: ProjectTeam
+metadata:
+  name: myproj
+spec:
+  source:
+    repo: example.com/acme/agents
+    tag: v1.0.0
+  defaults:
+    harnesses: [claude]
+  roles:
+    - ref: coder
+"""
+
+
+def _git(cwd, *argv):
+    subprocess.run(["git", *argv], cwd=cwd, check=True, capture_output=True,
+                   env={**os.environ,
+                        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                        "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"})
+
+
+@pytest.fixture
+def agents_repo(tmp_path):
+    repo = tmp_path / "agents-remote"
+    repo.mkdir()
+    (repo / "coder").mkdir()
+    (repo / "coder" / "role.yaml").write_text(ROLE_YAML)
+    (repo / "harnesses" / "claude").mkdir(parents=True)
+    (repo / "harnesses" / "claude" / "harness.yaml").write_text(HARNESS_YAML)
+    (repo / "harnesses" / "claude" / "blueprint.yaml.j2").write_text(TEMPLATE)
+    (repo / "harnesses" / "images.yaml").write_text(IMAGES_YAML)
+    _git(repo, "init", "-q", "-b", "main")
+    _git(repo, "add", ".")
+    _git(repo, "commit", "-q", "-m", "v1")
+    _git(repo, "tag", "v1.0.0")
+    return str(repo)
+
+
+@pytest.fixture
+def team_host(tmp_path, agents_repo):
+    base = tmp_path / "kuke-home"
+    host = TeamHost(str(base))
+    os.makedirs(base, mode=0o700, exist_ok=True)
+    (base / "kuketeams.yaml").write_text(f"""\
+apiVersion: kuketeams.io/v1
+kind: TeamsConfig
+spec:
+  git:
+    name: Op Erator
+    email: op@example.com
+  registry: reg.example.com
+  sources:
+    example.com/acme/agents: {agents_repo}
+  secrets:
+    api-key: {{from: secrets.env, key: API_KEY}}
+""")
+    return host
+
+
+class TestTypes:
+    def test_source_exactly_one_ref(self):
+        with pytest.raises(InvalidArgument):
+            TeamSource(repo="a/b", tag="v1", branch="main").ref()
+        with pytest.raises(InvalidArgument):
+            TeamSource(repo="a/b").ref()
+        assert TeamSource(repo="a/b", tag="v1").ref() == ("v1", "tag")
+
+    def test_source_host_defaulting(self):
+        assert TeamSource(repo="acme/agents", tag="v1").qualified_repo() \
+            == "github.com/acme/agents"
+        assert TeamSource(repo="gitlab.com/acme/agents", tag="v1").owner == "acme"
+
+    def test_string_source_rejected_with_migration_error(self):
+        with pytest.raises(InvalidArgument, match="structured"):
+            parse_team_documents("""\
+apiVersion: kuketeams.io/v1
+kind: ProjectTeam
+metadata: {name: p}
+spec:
+  source: acme/agents@v1
+  roles: [{ref: coder}]
+""")
+
+    def test_project_team_requires_roles(self):
+        with pytest.raises(InvalidArgument, match="at least one role"):
+            parse_team_documents("""\
+apiVersion: kuketeams.io/v1
+kind: ProjectTeam
+metadata: {name: p}
+spec:
+  source: {repo: a/b, tag: v1}
+  roles: []
+""")
+
+    def test_teams_config_rejects_inline_secret_values(self):
+        with pytest.raises(InvalidArgument, match="from"):
+            parse_team_documents("""\
+apiVersion: kuketeams.io/v1
+kind: TeamsConfig
+spec:
+  secrets:
+    api-key: {value: oops}
+""")
+
+    def test_wrong_api_version(self):
+        with pytest.raises(InvalidArgument, match="apiVersion"):
+            parse_team_documents("apiVersion: v1\nkind: Role\n")
+
+
+class TestHost:
+    def test_scaffold_and_load_config(self, tmp_path):
+        host = TeamHost(str(tmp_path / "home"))
+        cfg = host.load_config()
+        assert isinstance(cfg, tt.TeamsConfig)
+        assert os.path.exists(host.config_path())
+
+    def test_dropin_roundtrip(self, tmp_path):
+        host = TeamHost(str(tmp_path / "home"))
+        entry = tt.TeamEntry(name="p", path="/src/p",
+                             source=TeamSource(repo="a/b", branch="main"))
+        host.write_dropin(entry)
+        got = host.load_dropin("p")
+        assert got.path == "/src/p"
+        assert got.source.branch == "main"
+
+    def test_missing_dropin_is_none(self, tmp_path):
+        assert TeamHost(str(tmp_path / "home")).load_dropin("nope") is None
+
+
+class TestSecrets:
+    def test_two_layer_merge_per_team_wins(self, team_host):
+        cfg = team_host.load_config()
+        os.makedirs(os.path.dirname(team_host.shared_secrets_path()), exist_ok=True)
+        with open(team_host.shared_secrets_path(), "w") as f:
+            f.write("API_KEY=shared\n")
+        os.makedirs(os.path.dirname(team_host.team_secrets_path("myproj")), exist_ok=True)
+        with open(team_host.team_secrets_path("myproj"), "w") as f:
+            f.write("API_KEY=per-team\n")
+        vals = load_team_secrets(team_host, cfg, "myproj")
+        assert vals == {"api-key": "per-team"}
+
+    def test_scaffolds_missing_keys_0600(self, team_host):
+        cfg = team_host.load_config()
+        vals = load_team_secrets(team_host, cfg, "myproj")
+        assert vals == {"api-key": ""}
+        path = team_host.team_secrets_path("myproj")
+        assert open(path).read() == "API_KEY=\n"
+        assert (os.stat(path).st_mode & 0o777) == 0o600
+
+    def test_secret_documents_shape(self):
+        docs = secret_documents({"api-key": "s3cr3t"}, "proj", "default")
+        assert len(docs) == 1
+        assert docs[0].metadata.labels["kukeon.io/team"] == "proj"
+        assert docs[0].spec.data == {"value": "s3cr3t"}
+
+
+class TestSource:
+    def test_pinned_tag_clones_once_then_reuses(self, team_host):
+        cfg = team_host.load_config()
+        src = TeamSource(repo="example.com/acme/agents", tag="v1.0.0")
+        r = TeamSourceResolver(team_host, cfg)
+        d1 = r.resolve(src)
+        assert os.path.exists(os.path.join(d1, "coder", "role.yaml"))
+        marker = os.path.join(d1, "MARKER")
+        open(marker, "w").close()
+        d2 = r.resolve(src)          # pinned: reused as-is
+        assert d2 == d1 and os.path.exists(marker)
+
+    def test_floating_branch_resets_to_tip(self, team_host, agents_repo):
+        cfg = team_host.load_config()
+        src = TeamSource(repo="example.com/acme/agents", branch="main")
+        r = TeamSourceResolver(team_host, cfg)
+        d1 = r.resolve(src)
+        # Remote moves forward.
+        with open(os.path.join(agents_repo, "NEW"), "w") as f:
+            f.write("x")
+        _git(agents_repo, "add", "NEW")
+        _git(agents_repo, "commit", "-q", "-m", "tip")
+        d2 = r.resolve(src)
+        assert d2 == d1
+        assert os.path.exists(os.path.join(d2, "NEW"))
+
+    def test_load_bundle(self, team_host):
+        cfg = team_host.load_config()
+        team = load_project_team_from_str(PROJECT_YAML)
+        r = TeamSourceResolver(team_host, cfg)
+        bundle = r.load_bundle(team, r.resolve(team.source))
+        assert bundle.roles["coder"].needs.image == ["python"]
+        assert bundle.harnesses["claude"].template == "blueprint.yaml.j2"
+        assert len(bundle.catalog.images) == 2
+
+
+def load_project_team_from_str(s: str) -> tt.ProjectTeam:
+    return [d for d in parse_team_documents(s)
+            if isinstance(d, tt.ProjectTeam)][0]
+
+
+class TestRender:
+    @pytest.fixture
+    def bundle(self, team_host):
+        cfg = team_host.load_config()
+        team = load_project_team_from_str(PROJECT_YAML)
+        r = TeamSourceResolver(team_host, cfg)
+        return team, r.load_bundle(team, r.resolve(team.source)), cfg
+
+    def test_renders_pair_per_role_harness(self, bundle):
+        team, b, cfg = bundle
+        res = render_team(team, b, cfg)
+        assert len(res.blueprints) == 1 and len(res.configs) == 1
+        bp, cf = res.blueprints[0], res.configs[0]
+        assert bp.metadata.name == "myproj-coder-claude"
+        assert cf.spec.blueprint == bp.metadata.name
+        assert bp.metadata.labels["kukeon.io/team"] == "myproj"
+        assert cf.metadata.labels["kukeon.io/team"] == "myproj"
+
+    def test_image_select_picks_capability_superset(self, bundle):
+        team, b, cfg = bundle
+        res = render_team(team, b, cfg)
+        # needs [git?, python] -> claude-py (claude-basic lacks python)
+        assert res.images_used[0].ref == "claude-py"
+        cmd = res.blueprints[0].spec.cell.containers[0].command
+        assert "coder@kukeon.internal/claude-py:v1" in cmd[-1]
+
+    def test_image_select_miss_names_capability(self, bundle):
+        team, b, cfg = bundle
+        team.roles[0].needs.image.append("rust")
+        with pytest.raises(InvalidArgument, match="rust"):
+            render_team(team, b, cfg)
+
+    def test_operator_facts_rendered_and_bound(self, bundle):
+        team, b, cfg = bundle
+        res = render_team(team, b, cfg)
+        env = {e.name: e.value
+               for e in res.blueprints[0].spec.cell.containers[0].env}
+        assert env["GIT_AUTHOR_NAME"] == "Op Erator"
+        assert env["SKILLS"] == "git,python"
+        assert res.configs[0].spec.values["OPERATOR_REGISTRY"] == "reg.example.com"
+
+    def test_secret_binding_only_for_declared_slots(self, bundle):
+        team, b, cfg = bundle
+        res = render_team(team, b, cfg)
+        assert [s.slot for s in res.configs[0].spec.secrets] == ["api-key"]
+        assert res.secrets_needed == ["api-key"]
+
+    def test_undeclared_secret_errors(self, bundle):
+        team, b, cfg = bundle
+        cfg.secrets.pop("api-key")
+        with pytest.raises(InvalidArgument, match="api-key"):
+            render_team(team, b, cfg)
+
+    def test_deterministic(self, bundle):
+        team, b, cfg = bundle
+        from kukeon_tpu.runtime.apply.parser import dump_documents
+
+        r1 = render_team(team, b, cfg)
+        r2 = render_team(team, b, cfg)
+        assert dump_documents(r1.blueprints + r1.configs) \
+            == dump_documents(r2.blueprints + r2.configs)
+
+
+class TestTeamInit:
+    def test_full_pipeline_applies_and_prunes(self, tmp_path, team_host):
+        # Fill the secret so init can ship it.
+        os.makedirs(os.path.dirname(team_host.team_secrets_path("myproj")),
+                    exist_ok=True)
+        with open(team_host.team_secrets_path("myproj"), "w") as f:
+            f.write("API_KEY=k\n")
+        project_file = tmp_path / "team.yaml"
+        project_file.write_text(PROJECT_YAML)
+
+        store = ResourceStore(MetadataStore(str(tmp_path / "rp")))
+        ctl = Controller(store, Runner(store, FakeBackend()))
+        ctl.bootstrap()
+
+        def apply_fn(blob, team, prune):
+            return [vars(r) for r in
+                    ctl.apply_documents(blob, team=team, prune=prune)]
+
+        res = team_init(apply_fn, str(project_file), host=team_host)
+        actions = {(r["kind"], r["name"]): r["action"] for r in res.applied}
+        assert actions[("Secret", "api-key")] == "applied"
+        assert actions[("CellBlueprint", "myproj-coder-claude")] == "applied"
+        assert actions[("CellConfig", "myproj-coder-claude")] == "applied"
+        # Config materialized its cell.
+        cells = ctl.list_cells(consts.DEFAULT_REALM)
+        names = [c["name"] for c in cells]
+        assert "myproj-coder-claude" in names
+
+    def test_reinit_prunes_removed_roles(self, tmp_path, team_host):
+        os.makedirs(os.path.dirname(team_host.team_secrets_path("myproj")),
+                    exist_ok=True)
+        with open(team_host.team_secrets_path("myproj"), "w") as f:
+            f.write("API_KEY=k\n")
+        project_file = tmp_path / "team.yaml"
+        project_file.write_text(PROJECT_YAML)
+
+        store = ResourceStore(MetadataStore(str(tmp_path / "rp")))
+        ctl = Controller(store, Runner(store, FakeBackend()))
+        ctl.bootstrap()
+
+        def apply_fn(blob, team, prune):
+            return [vars(r) for r in
+                    ctl.apply_documents(blob, team=team, prune=prune)]
+
+        team_init(apply_fn, str(project_file), host=team_host)
+        # Re-apply the team with an empty roster slice (just the secret):
+        # every rendered object must be pruned.
+        blob = ("apiVersion: kukeon.io/v1beta1\nkind: Secret\n"
+                "metadata: {name: api-key, realm: default}\n"
+                "spec: {data: {value: k}}\n")
+        results = ctl.apply_documents(blob, team="myproj", prune=True)
+        pruned = {(r.kind, r.name) for r in results if r.action == "pruned"}
+        assert ("Cell", "myproj-coder-claude") in pruned
+        assert ("CellConfig", "myproj-coder-claude") in pruned
+        assert ("CellBlueprint", "myproj-coder-claude") in pruned
+        assert ("Secret", "api-key") not in pruned   # still in the roster
+
+    def test_dry_run_touches_nothing(self, tmp_path, team_host):
+        project_file = tmp_path / "team.yaml"
+        project_file.write_text(PROJECT_YAML)
+        res = team_init(None, str(project_file), host=team_host, dry_run=True)
+        assert res.rendered is not None
+        assert res.applied == []
+
+    def test_missing_secret_value_fails_with_path_hint(self, tmp_path, team_host):
+        project_file = tmp_path / "team.yaml"
+        project_file.write_text(PROJECT_YAML)
+        with pytest.raises(InvalidArgument, match="secrets.env"):
+            team_init(lambda *a: [], str(project_file), host=team_host)
