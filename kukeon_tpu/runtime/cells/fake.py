@@ -32,6 +32,7 @@ class FakeBackend(CellBackend):
         self.entries: dict[str, _Entry] = {}
         self.fail_start: set[str] = set()        # container dirs that fail to start
         self.auto_exit: dict[str, int] = {}      # dir -> exit code right after start
+        self.started: list[ContainerContext] = []   # every start, in order
         self._next_pid = 1000
 
     def entry(self, ctx: ContainerContext) -> _Entry:
@@ -44,6 +45,7 @@ class FakeBackend(CellBackend):
             raise Unavailable(f"fake: start failure for {ctx.container_dir}")
         e = self.entry(ctx)
         e.starts += 1
+        self.started.append(ctx)
         self._next_pid += 1
         e.pid = self._next_pid
         if ctx.container_dir in self.auto_exit:

@@ -71,8 +71,10 @@ class ProcessBackend(CellBackend):
     def start_container(self, ctx: ContainerContext) -> int:
         if not ctx.command:
             raise FailedPrecondition(
-                f"container has no command (image-backed cells need the containerd backend)"
+                "container has no command and its image (if any) has no "
+                "entrypoint"
             )
+        ctx.command = self._overlay_command(ctx)
         p = self.paths(ctx)
         os.makedirs(ctx.container_dir, exist_ok=True)
         # A fresh start invalidates previous run artifacts.
@@ -177,6 +179,26 @@ class ProcessBackend(CellBackend):
                 pass
 
     # --- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _overlay_command(ctx: ContainerContext) -> list[str]:
+        """Image-path overlay: absolute argv components that exist inside the
+        image's rootfs resolve there; everything else resolves on the host.
+        This is the process backend's analog of a mount namespace — a scratch
+        image's /bin/app.sh runs via the host's /bin/sh, and workloads read
+        their bundle files at their in-image paths."""
+        rootfs = ctx.env.get("KUKEON_IMAGE_ROOTFS")
+        if not rootfs:
+            return ctx.command
+        out = []
+        for arg in ctx.command:
+            if arg.startswith("/"):
+                candidate = os.path.join(rootfs, arg.lstrip("/"))
+                if os.path.exists(candidate):
+                    out.append(candidate)
+                    continue
+            out.append(arg)
+        return out
 
     def _reap(self) -> None:
         """Collect any finished supervisors we spawned (avoid zombies)."""

@@ -55,6 +55,69 @@ def _print(obj, as_json=False):
 
 # --- verb implementations ----------------------------------------------------
 
+def cmd_image(args):
+    c = _client(args)
+    sub = args.image_cmd
+    if sub in ("get", "delete", "save", "load") and not args.ref:
+        print(f"error: image {sub} needs an image ref", file=sys.stderr)
+        return 2
+    if sub == "load" and not args.input:
+        print("error: image load needs -i/--input <tarball>", file=sys.stderr)
+        return 2
+    if sub == "save" and not args.output:
+        print("error: image save needs -o/--output <tarball>", file=sys.stderr)
+        return 2
+    if sub == "list":
+        rows = c.call("ListImages")
+        if args.json:
+            _print(rows, True)
+        else:
+            print(f"{'REF':40} {'PARENT':30} CREATED")
+            for m in rows:
+                created = time.strftime("%Y-%m-%d %H:%M",
+                                        time.localtime(m["createdAt"]))
+                print(f"{m['name'] + ':' + m['tag']:40} "
+                      f"{m['parent'] or '-':30} {created}")
+    elif sub == "get":
+        _print(c.call("GetImage", ref=args.ref), args.json)
+    elif sub == "delete":
+        c.call("DeleteImage", ref=args.ref)
+        print(f"image/{args.ref}: deleted")
+    elif sub == "prune":
+        removed = c.call("PruneImages")
+        for r in removed:
+            print(f"image/{r}: pruned")
+        print(f"{len(removed)} image(s) pruned")
+    elif sub == "load":
+        m = c.call("LoadImage", tarPath=os.path.abspath(args.input), ref=args.ref)
+        print(f"image/{m['name']}:{m['tag']}: loaded")
+    elif sub == "save":
+        c.call("SaveImage", ref=args.ref, tarPath=os.path.abspath(args.output))
+        print(f"image/{args.ref}: saved to {args.output}")
+    else:
+        print(f"unknown image subcommand {sub!r}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def cmd_build(args):
+    # Standalone like the reference's kukebuild: writes straight into the
+    # store, no daemon required.
+    from kukeon_tpu.runtime.images import ImageBuilder, ImageStore
+
+    context = os.path.abspath(args.context)
+    kukefile = args.file or os.path.join(context, "Kukefile")
+    build_args = {}
+    for kv in args.build_arg or []:
+        k, _, v = kv.partition("=")
+        build_args[k] = v
+    builder = ImageBuilder(ImageStore(_run_path(args)))
+    m = builder.build(kukefile, context_dir=context, tag=args.tag,
+                      build_args=build_args)
+    print(f"image/{m.ref}: built")
+    return 0
+
+
 def cmd_team(args):
     from kukeon_tpu.runtime.teams import TeamHost, team_init
 
@@ -573,6 +636,19 @@ def build_parser() -> argparse.ArgumentParser:
     sub_add("doctor")
     sub_add("refresh")
 
+    sp = sub_add("image")
+    sp.add_argument("image_cmd",
+                    choices=["list", "get", "delete", "prune", "load", "save"])
+    sp.add_argument("ref", nargs="?", default=None)
+    sp.add_argument("-i", "--input", default=None, help="tarball to load")
+    sp.add_argument("-o", "--output", default=None, help="tarball to save to")
+
+    sp = sub_add("build")
+    sp.add_argument("context", nargs="?", default=".")
+    sp.add_argument("-t", "--tag", required=True)
+    sp.add_argument("-f", "--file", default=None, help="Kukefile path")
+    sp.add_argument("--build-arg", action="append", help="KEY=VALUE")
+
     sp = sub_add("team")
     sp.add_argument("team_cmd", choices=["init"])
     sp.add_argument("-f", "--file", required=True, help="ProjectTeam manifest")
@@ -613,6 +689,8 @@ HANDLERS = {
     "doctor": cmd_doctor,
     "refresh": cmd_refresh,
     "purge": cmd_purge,
+    "image": cmd_image,
+    "build": cmd_build,
     "team": cmd_team,
     "uninstall": cmd_uninstall,
 }

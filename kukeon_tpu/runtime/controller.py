@@ -539,6 +539,16 @@ class Controller:
 
     # --- reconcile (reference: reconcile.go:52-206) ------------------------
 
+    def images_in_use(self) -> set[str]:
+        """Image refs referenced by any cell container spec (prune keep-set)."""
+        out: set[str] = set()
+        for realm in self.store.list_realms():
+            for rec in self.list_cells(realm):
+                for c in rec.get("spec", {}).get("containers", []):
+                    if c.get("image"):
+                        out.add(c["image"])
+        return out
+
     def reconcile_space_networks(self) -> dict[str, dict]:
         """Re-assert every space's bridge/conflist/egress chain (reference:
         ReconcileSpaceNetworks, reconcile.go:52-66 — heals reboot flushes)."""

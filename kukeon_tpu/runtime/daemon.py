@@ -245,6 +245,30 @@ class RPCService:
         rec = self.ctl.store.read_cell(realm, space, stack, cell)
         return self.ctl.runner.cell_containers(rec)
 
+    # Images (reference: kuke image verbs over internal/ctr image.go).
+    def _image_store(self):
+        from kukeon_tpu.runtime.images import ImageStore
+
+        return ImageStore(self.ctl.store.ms.root)
+
+    def ListImages(self) -> list[dict]:
+        return [m.to_json() for m in self._image_store().list()]
+
+    def GetImage(self, ref: str) -> dict:
+        return self._image_store().get(ref).to_json()
+
+    def DeleteImage(self, ref: str) -> None:
+        self._image_store().delete(ref)
+
+    def PruneImages(self) -> list[str]:
+        return self._image_store().prune(self.ctl.images_in_use())
+
+    def LoadImage(self, tarPath: str, ref: str) -> dict:
+        return self._image_store().load_tar(tarPath, ref).to_json()
+
+    def SaveImage(self, ref: str, tarPath: str) -> None:
+        self._image_store().save_tar(ref, tarPath)
+
     def ReconcileNow(self) -> dict:
         return self.ctl.reconcile_cells()
 

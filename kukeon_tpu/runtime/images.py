@@ -1,0 +1,386 @@
+"""Image subsystem: store + Kukefile builder (the kukebuild analog).
+
+Reference seams covered (SURVEY.md §2.1 kukebuild, §2.6 internal/ctr
+images): image load/list/get/delete/prune, and a standalone builder that
+writes images straight into the store (the reference embeds BuildKit and
+writes into containerd's namespace; here the store IS the runtime's image
+namespace).
+
+Process-backend image model: an image is a versioned bundle
+
+  <run_path>/images/<encoded name:tag>/
+    manifest.json     {name, tag, parent, entrypoint, cmd, env, workdir,
+                       labels, createdAt}
+    rootfs/           overlay tree the workload sees via KUKEON_IMAGE_*
+
+A container whose spec names an image inherits the image's env/entrypoint/
+workdir (spec wins on conflict) and gets KUKEON_IMAGE_ROOTFS pointing at
+the bundle tree — full mount-namespace isolation belongs to a containerd
+backend; this backend's contract is env + entry + files.
+
+Kukefile grammar (Dockerfile subset, enough for the reference's team image
+flow: FROM walk, build args, REGISTRY threading):
+
+  ARG NAME[=default]
+  FROM <image[:tag]> | scratch
+  COPY <src> <dst>
+  ENV KEY=VALUE
+  WORKDIR <dir>
+  LABEL k=v
+  RUN <command...>              # executed with rootfs as cwd
+  ENTRYPOINT ["a","b"] | cmd    # exec or shell form
+  CMD ["a","b"] | cmd
+
+``${ARG}``/`$ARG` substitution applies to FROM/COPY/ENV/LABEL/WORKDIR
+values, matching how the reference threads the REGISTRY build-arg.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shlex
+import shutil
+import subprocess
+import time
+from dataclasses import dataclass, field
+
+from kukeon_tpu.runtime.errors import InvalidArgument, NotFound
+
+IMAGES_DIR = "images"
+
+
+def split_ref(ref: str) -> tuple[str, str]:
+    """name[:tag] -> (name, tag); tag defaults to latest."""
+    if ":" in ref.rsplit("/", 1)[-1]:
+        name, _, tag = ref.rpartition(":")
+        return name, tag
+    return ref, "latest"
+
+
+def encode_ref(ref: str) -> str:
+    name, tag = split_ref(ref)
+    return f"{name}:{tag}".replace("/", "_")
+
+
+@dataclass
+class ImageManifest:
+    name: str = ""
+    tag: str = "latest"
+    parent: str = ""                 # FROM ref ("" = scratch)
+    entrypoint: list[str] = field(default_factory=list)
+    cmd: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+    workdir: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    created_at: float = 0.0
+
+    @property
+    def ref(self) -> str:
+        return f"{self.name}:{self.tag}"
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name, "tag": self.tag, "parent": self.parent,
+            "entrypoint": self.entrypoint, "cmd": self.cmd, "env": self.env,
+            "workdir": self.workdir, "labels": self.labels,
+            "createdAt": self.created_at,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "ImageManifest":
+        return ImageManifest(
+            name=d.get("name", ""), tag=d.get("tag", "latest"),
+            parent=d.get("parent", ""),
+            entrypoint=list(d.get("entrypoint") or []),
+            cmd=list(d.get("cmd") or []),
+            env=dict(d.get("env") or {}),
+            workdir=d.get("workdir", ""),
+            labels=dict(d.get("labels") or {}),
+            created_at=d.get("createdAt", 0.0),
+        )
+
+
+class ImageStore:
+    def __init__(self, run_path: str):
+        self.root = os.path.join(run_path, IMAGES_DIR)
+
+    def _dir(self, ref: str) -> str:
+        return os.path.join(self.root, encode_ref(ref))
+
+    def rootfs(self, ref: str) -> str:
+        return os.path.join(self._dir(ref), "rootfs")
+
+    def exists(self, ref: str) -> bool:
+        return os.path.exists(os.path.join(self._dir(ref), "manifest.json"))
+
+    def get(self, ref: str) -> ImageManifest:
+        path = os.path.join(self._dir(ref), "manifest.json")
+        if not os.path.exists(path):
+            raise NotFound(f"image {ref!r} not found")
+        with open(path) as f:
+            return ImageManifest.from_json(json.load(f))
+
+    def list(self) -> list[ImageManifest]:
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for entry in sorted(os.listdir(self.root)):
+            path = os.path.join(self.root, entry, "manifest.json")
+            if os.path.exists(path):
+                with open(path) as f:
+                    out.append(ImageManifest.from_json(json.load(f)))
+        return out
+
+    def put(self, manifest: ImageManifest) -> str:
+        d = self._dir(manifest.ref)
+        os.makedirs(os.path.join(d, "rootfs"), exist_ok=True)
+        manifest.created_at = manifest.created_at or time.time()
+        tmp = os.path.join(d, ".manifest.tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest.to_json(), f, indent=2)
+        os.replace(tmp, os.path.join(d, "manifest.json"))
+        return d
+
+    def delete(self, ref: str) -> None:
+        if not self.exists(ref):
+            raise NotFound(f"image {ref!r} not found")
+        shutil.rmtree(self._dir(ref), ignore_errors=True)
+
+    def prune(self, in_use: set[str]) -> list[str]:
+        """Delete images not referenced by any cell spec; returns refs
+        removed. Parents of in-use images are kept (FROM chains stay
+        rebuildable)."""
+        keep = set()
+        for ref in in_use:
+            cur = ref
+            while cur and cur not in keep:
+                keep.add(cur)
+                try:
+                    cur = self.get(cur).parent
+                except NotFound:
+                    break
+        removed = []
+        for m in self.list():
+            if m.ref not in keep:
+                self.delete(m.ref)
+                removed.append(m.ref)
+        return removed
+
+    # --- tar import/export (kuke image load / save) -------------------------
+
+    # The metadata tar member lives under rootfs/ in the archive layout:
+    # `rootfs/...` entries are the filesystem, this sibling member is the
+    # manifest — so a real /manifest.json INSIDE the image never collides.
+    _TAR_META = "kukeon-manifest.json"
+    _TAR_ROOTFS = "rootfs"
+
+    def load_tar(self, tar_path: str, ref: str) -> ImageManifest:
+        """Import a tarball as an image. Layout: ``rootfs/`` tree + optional
+        sibling ``kukeon-manifest.json`` with runtime metadata. A flat tar
+        (no rootfs/ prefix) imports as a bare rootfs for convenience."""
+        import tarfile
+
+        name, tag = split_ref(ref)
+        m = ImageManifest(name=name, tag=tag)
+        d = self.put(m)
+        rootfs = os.path.join(d, "rootfs")
+        with tarfile.open(tar_path) as tf:
+            names = tf.getnames()
+            structured = any(
+                n == self._TAR_ROOTFS or n.startswith(self._TAR_ROOTFS + "/")
+                for n in names
+            )
+            if structured:
+                tf.extractall(d, filter="data",
+                              members=[mem for mem in tf.getmembers()
+                                       if mem.name == self._TAR_ROOTFS
+                                       or mem.name.startswith(self._TAR_ROOTFS + "/")])
+                meta_member = next(
+                    (mem for mem in tf.getmembers()
+                     if mem.name == self._TAR_META), None
+                )
+                if meta_member is not None:
+                    meta = json.load(tf.extractfile(meta_member))
+                    m.entrypoint = list(meta.get("entrypoint") or [])
+                    m.cmd = list(meta.get("cmd") or [])
+                    m.env = dict(meta.get("env") or {})
+                    m.workdir = meta.get("workdir", "")
+                    m.labels = dict(meta.get("labels") or {})
+            else:
+                tf.extractall(rootfs, filter="data")
+        self.put(m)
+        return m
+
+    def save_tar(self, ref: str, tar_path: str) -> None:
+        import io
+        import tarfile
+
+        m = self.get(ref)
+        rootfs = self.rootfs(ref)
+        with tarfile.open(tar_path, "w") as tf:
+            tf.add(rootfs, arcname=self._TAR_ROOTFS)
+            meta = json.dumps({
+                "entrypoint": m.entrypoint, "cmd": m.cmd, "env": m.env,
+                "workdir": m.workdir, "labels": m.labels,
+            }).encode()
+            info = tarfile.TarInfo(self._TAR_META)
+            info.size = len(meta)
+            tf.addfile(info, io.BytesIO(meta))
+
+
+# --- Kukefile ----------------------------------------------------------------
+
+
+@dataclass
+class Instruction:
+    op: str
+    args: list[str]
+
+
+_VAR_RE = re.compile(r"\$\{(\w+)\}|\$(\w+)")
+
+
+def parse_kukefile(text: str, origin: str = "Kukefile") -> list[Instruction]:
+    out = []
+    continuation = ""
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = continuation + raw.strip()
+        continuation = ""
+        if not line or line.startswith("#"):
+            continue
+        if line.endswith("\\"):
+            continuation = line[:-1].rstrip() + " "
+            continue
+        op, _, rest = line.partition(" ")
+        op = op.upper()
+        if op not in ("ARG", "FROM", "COPY", "ENV", "WORKDIR", "LABEL",
+                      "RUN", "ENTRYPOINT", "CMD"):
+            raise InvalidArgument(f"{origin}:{lineno}: unknown instruction {op!r}")
+        out.append(Instruction(op=op, args=[rest.strip()]))
+    if continuation:
+        raise InvalidArgument(f"{origin}: dangling line continuation")
+    return out
+
+
+def _subst(value: str, vars_: dict[str, str]) -> str:
+    def repl(m):
+        key = m.group(1) or m.group(2)
+        return vars_.get(key, "")
+    return _VAR_RE.sub(repl, value)
+
+
+def _parse_exec_form(rest: str) -> list[str]:
+    rest = rest.strip()
+    if rest.startswith("["):
+        try:
+            parsed = json.loads(rest)
+        except json.JSONDecodeError as e:
+            raise InvalidArgument(f"bad exec form {rest!r}: {e}") from e
+        return [str(x) for x in parsed]
+    return ["/bin/sh", "-c", rest]
+
+
+def base_of(kukefile_path: str, build_args: dict[str, str] | None = None) -> str:
+    """The (substituted) FROM ref, or "" for scratch — the teambuild
+    FROM-order walk's input."""
+    with open(kukefile_path) as f:
+        instrs = parse_kukefile(f.read(), origin=kukefile_path)
+    vars_ = dict(build_args or {})
+    for ins in instrs:
+        if ins.op == "ARG":
+            name, _, default = ins.args[0].partition("=")
+            vars_.setdefault(name.strip(), default.strip())
+        elif ins.op == "FROM":
+            ref = _subst(ins.args[0], vars_).strip()
+            return "" if ref == "scratch" else ref
+    return ""
+
+
+class ImageBuilder:
+    """Builds store images from Kukefiles (standalone, no daemon — like
+    kukebuild writing straight into the namespace)."""
+
+    def __init__(self, store: ImageStore):
+        self.store = store
+
+    def base_of(self, kukefile_path: str,
+                build_args: dict[str, str] | None = None) -> str:
+        return base_of(kukefile_path, build_args)
+
+    def build(self, kukefile_path: str, context_dir: str, tag: str,
+              build_args: dict[str, str] | None = None) -> ImageManifest:
+        with open(kukefile_path) as f:
+            instrs = parse_kukefile(f.read(), origin=kukefile_path)
+
+        name, tag_ = split_ref(tag)
+        m = ImageManifest(name=name, tag=tag_)
+        vars_ = dict(build_args or {})
+        d = self.store.put(m)
+        rootfs = os.path.join(d, "rootfs")
+        seen_from = False
+
+        for ins in instrs:
+            rest = ins.args[0]
+            if ins.op == "ARG":
+                arg_name, _, default = rest.partition("=")
+                vars_.setdefault(arg_name.strip(), default.strip())
+            elif ins.op == "FROM":
+                if seen_from:
+                    raise InvalidArgument(
+                        f"{kukefile_path}: multi-stage builds not supported"
+                    )
+                seen_from = True
+                base_ref = _subst(rest, vars_).strip()
+                if base_ref != "scratch":
+                    base = self.store.get(base_ref)   # NotFound if missing
+                    m.parent = base.ref
+                    m.entrypoint = list(base.entrypoint)
+                    m.cmd = list(base.cmd)
+                    m.env = dict(base.env)
+                    m.workdir = base.workdir
+                    m.labels = dict(base.labels)
+                    shutil.rmtree(rootfs, ignore_errors=True)
+                    shutil.copytree(self.store.rootfs(base.ref), rootfs,
+                                    symlinks=True)
+            elif ins.op == "COPY":
+                parts = shlex.split(_subst(rest, vars_))
+                if len(parts) != 2:
+                    raise InvalidArgument(f"COPY wants <src> <dst>: {rest!r}")
+                ctx_abs = os.path.abspath(context_dir)
+                src = os.path.abspath(os.path.join(ctx_abs, parts[0]))
+                if src != ctx_abs and not src.startswith(ctx_abs + os.sep):
+                    raise InvalidArgument(f"COPY src escapes context: {parts[0]!r}")
+                dst = os.path.join(rootfs, parts[1].lstrip("/"))
+                if os.path.isdir(src):
+                    shutil.copytree(src, dst, dirs_exist_ok=True, symlinks=True)
+                else:
+                    os.makedirs(os.path.dirname(dst), exist_ok=True)
+                    shutil.copy2(src, dst)
+            elif ins.op == "ENV":
+                k, _, v = _subst(rest, vars_).partition("=")
+                m.env[k.strip()] = v.strip()
+            elif ins.op == "WORKDIR":
+                m.workdir = _subst(rest, vars_).strip()
+            elif ins.op == "LABEL":
+                k, _, v = _subst(rest, vars_).partition("=")
+                m.labels[k.strip()] = v.strip()
+            elif ins.op == "RUN":
+                cmd = _parse_exec_form(_subst(rest, vars_))
+                env = {**os.environ, **m.env, "KUKEON_BUILD_ROOT": rootfs}
+                p = subprocess.run(cmd, cwd=rootfs, env=env,
+                                   capture_output=True, text=True,
+                                   timeout=600, check=False)
+                if p.returncode != 0:
+                    raise InvalidArgument(
+                        f"RUN {rest!r} failed ({p.returncode}): "
+                        f"{(p.stdout + p.stderr).strip()[-500:]}"
+                    )
+            elif ins.op == "ENTRYPOINT":
+                m.entrypoint = _parse_exec_form(_subst(rest, vars_))
+            elif ins.op == "CMD":
+                m.cmd = _parse_exec_form(_subst(rest, vars_))
+
+        self.store.put(m)
+        return m

@@ -253,6 +253,22 @@ class Runner:
             "KUKEON_CELL": rec.name,
             "KUKEON_CONTAINER": spec.name,
         }
+        image_entrypoint: list[str] = []
+        image_cmd: list[str] = []
+        workdir = spec.workdir
+        if spec.image:
+            # Image-backed container: inherit the image's env/entry/workdir
+            # (spec wins on conflict) + expose the bundle tree.
+            from kukeon_tpu.runtime.images import ImageStore
+
+            istore = ImageStore(self.store.ms.root)
+            manifest = istore.get(spec.image)
+            env.update(manifest.env)
+            env["KUKEON_IMAGE"] = manifest.ref
+            env["KUKEON_IMAGE_ROOTFS"] = istore.rootfs(manifest.ref)
+            image_entrypoint = list(manifest.entrypoint)
+            image_cmd = list(manifest.cmd)
+            workdir = workdir or manifest.workdir or None
         for e in spec.env:
             env[e.name] = e.value
         self._stage_secrets(rec, spec, cdir, env)
@@ -269,13 +285,21 @@ class Runner:
                 cpu=spec.resources.cpu,
                 pids=spec.resources.pids,
             )
+        command = list(spec.command) + list(spec.args)
+        if not spec.command and spec.image:
+            # Docker/k8s semantics: spec.args replaces the image CMD while
+            # keeping its entrypoint; with no args, entrypoint+cmd run.
+            if spec.args:
+                command = image_entrypoint + list(spec.args)
+            else:
+                command = image_entrypoint + image_cmd
         return ContainerContext(
             container_dir=cdir,
             spec=spec,
             env=env,
-            command=list(spec.command) + list(spec.args),
+            command=command,
             cgroup_dir=cgroup_dir,
-            workdir=spec.workdir,
+            workdir=workdir,
         )
 
     def _stage_secrets(self, rec: model.CellRecord, spec: t.ContainerSpec,

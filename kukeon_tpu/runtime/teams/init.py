@@ -122,14 +122,15 @@ def build_team_images(builder, bundle, cfg: tt.TeamsConfig,
             )
         kukefile = os.path.join(checkout, entry.build.context,
                                 entry.build.dockerfile or "Kukefile")
-        base = builder.base_of(kukefile)
+        build_args = {"REGISTRY": cfg.registry} if cfg.registry else {}
+        base = builder.base_of(kukefile, build_args)
         if base in by_image:
             visit(by_image[base], [*chain, entry.image])
         builder.build(
             kukefile,
             context_dir=os.path.join(checkout, entry.build.context),
             tag=entry.image,
-            build_args={"REGISTRY": cfg.registry} if cfg.registry else {},
+            build_args=build_args,
         )
         seen.add(entry.image)
         built.append(entry.image)

@@ -106,7 +106,7 @@ def render_team(team: tt.ProjectTeam, bundle: SourceBundle,
         role = bundle.roles[project_role.ref]
         harness_names = sorted(
             set(role.harnesses) | set(team.defaults.harnesses)
-        ) or sorted(team.defaults.harnesses)
+        )
         if not harness_names:
             raise InvalidArgument(
                 f"role {role.name!r} has no harnesses and the project sets "

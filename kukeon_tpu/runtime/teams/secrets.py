@@ -64,7 +64,10 @@ def load_team_secrets(host: TeamHost, cfg: tt.TeamsConfig,
     out: dict[str, str] = {}
     for name in wanted:
         key = cfg.secrets[name].key or name
-        out[name] = per_team.get(key, shared.get(key, ""))
+        # An empty per-team value (incl. the scaffolded `KEY=` line) falls
+        # through to the shared layer — scaffolding must never mask a
+        # filled host-wide secret.
+        out[name] = per_team.get(key) or shared.get(key, "")
     return out
 
 

@@ -1,0 +1,240 @@
+"""Image subsystem: store CRUD, tar load/save, Kukefile builder, FROM
+chains, prune keep-sets, and image-backed container resolution."""
+
+import os
+import subprocess
+import tarfile
+
+import pytest
+
+from kukeon_tpu.runtime import consts
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.cells.fake import FakeBackend
+from kukeon_tpu.runtime.controller import Controller
+from kukeon_tpu.runtime.errors import InvalidArgument, NotFound
+from kukeon_tpu.runtime.images import (
+    ImageBuilder,
+    ImageManifest,
+    ImageStore,
+    base_of,
+    parse_kukefile,
+    split_ref,
+)
+from kukeon_tpu.runtime.metadata import MetadataStore
+from kukeon_tpu.runtime.runner import Runner
+from kukeon_tpu.runtime.store import ResourceStore
+
+
+@pytest.fixture
+def store(tmp_path):
+    return ImageStore(str(tmp_path))
+
+
+class TestRefs:
+    def test_split_ref(self):
+        assert split_ref("busybox") == ("busybox", "latest")
+        assert split_ref("busybox:1.36") == ("busybox", "1.36")
+        assert split_ref("reg.example.com:5000/ns/app:v2") \
+            == ("reg.example.com:5000/ns/app", "v2")
+
+
+class TestStore:
+    def test_put_get_list_delete(self, store):
+        store.put(ImageManifest(name="a", tag="v1", env={"X": "1"}))
+        store.put(ImageManifest(name="b", tag="v1"))
+        assert store.get("a:v1").env == {"X": "1"}
+        assert [m.ref for m in store.list()] == ["a:v1", "b:v1"]
+        store.delete("a:v1")
+        with pytest.raises(NotFound):
+            store.get("a:v1")
+
+    def test_prune_keeps_in_use_and_parents(self, store):
+        store.put(ImageManifest(name="base", tag="v1"))
+        store.put(ImageManifest(name="app", tag="v1", parent="base:v1"))
+        store.put(ImageManifest(name="orphan", tag="v1"))
+        removed = store.prune(in_use={"app:v1"})
+        assert removed == ["orphan:v1"]
+        assert store.exists("base:v1") and store.exists("app:v1")
+
+    def test_tar_roundtrip(self, store, tmp_path):
+        m = ImageManifest(name="x", tag="v1", entrypoint=["/bin/run"],
+                          env={"A": "b"}, workdir="/w")
+        d = store.put(m)
+        with open(os.path.join(d, "rootfs", "hello.txt"), "w") as f:
+            f.write("hi")
+        store.put(m)
+        tar = str(tmp_path / "x.tar")
+        store.save_tar("x:v1", tar)
+        store2 = ImageStore(str(tmp_path / "other"))
+        got = store2.load_tar(tar, "y:v2")
+        assert got.entrypoint == ["/bin/run"]
+        assert got.env == {"A": "b"}
+        assert open(os.path.join(store2.rootfs("y:v2"), "hello.txt")).read() == "hi"
+
+
+class TestKukefile:
+    def test_parse_and_continuation(self):
+        instrs = parse_kukefile("FROM scratch\nRUN echo a \\\n  b\n# c\n")
+        assert [i.op for i in instrs] == ["FROM", "RUN"]
+        assert instrs[1].args[0] == "echo a b"
+
+    def test_unknown_instruction(self):
+        with pytest.raises(InvalidArgument, match="VOLUME"):
+            parse_kukefile("VOLUME /data\n")
+
+    def test_base_of_with_args(self, tmp_path):
+        kf = tmp_path / "Kukefile"
+        kf.write_text("ARG REGISTRY=reg.local\nFROM ${REGISTRY}/base:v1\n")
+        assert base_of(str(kf)) == "reg.local/base:v1"
+        assert base_of(str(kf), {"REGISTRY": "other"}) == "other/base:v1"
+
+    def test_base_of_scratch(self, tmp_path):
+        kf = tmp_path / "Kukefile"
+        kf.write_text("FROM scratch\n")
+        assert base_of(str(kf)) == ""
+
+
+class TestBuilder:
+    @pytest.fixture
+    def ctx(self, tmp_path):
+        c = tmp_path / "ctx"
+        c.mkdir()
+        (c / "app.sh").write_text("#!/bin/sh\necho app\n")
+        return str(c)
+
+    def test_build_scratch_with_copy_env_entry(self, store, ctx, tmp_path):
+        kf = tmp_path / "Kukefile"
+        kf.write_text(
+            "FROM scratch\n"
+            "COPY app.sh /bin/app.sh\n"
+            "ENV MODE=prod\n"
+            "WORKDIR /srv\n"
+            "LABEL team=demo\n"
+            'ENTRYPOINT ["/bin/sh", "/bin/app.sh"]\n'
+        )
+        m = ImageBuilder(store).build(str(kf), ctx, "app:v1")
+        assert m.env == {"MODE": "prod"}
+        assert m.workdir == "/srv"
+        assert m.labels == {"team": "demo"}
+        assert m.entrypoint == ["/bin/sh", "/bin/app.sh"]
+        assert os.path.exists(os.path.join(store.rootfs("app:v1"), "bin/app.sh"))
+
+    def test_build_from_chains_inherit(self, store, ctx, tmp_path):
+        base_kf = tmp_path / "Base"
+        base_kf.write_text("FROM scratch\nENV BASE=1\nCMD [\"/bin/base\"]\n")
+        ImageBuilder(store).build(str(base_kf), ctx, "base:v1")
+        kf = tmp_path / "Kukefile"
+        kf.write_text("FROM base:v1\nENV APP=2\n")
+        m = ImageBuilder(store).build(str(kf), ctx, "app:v1")
+        assert m.parent == "base:v1"
+        assert m.env == {"BASE": "1", "APP": "2"}
+        assert m.cmd == ["/bin/base"]
+
+    def test_run_executes_in_rootfs(self, store, ctx, tmp_path):
+        kf = tmp_path / "Kukefile"
+        kf.write_text("FROM scratch\nRUN echo built > marker.txt\n")
+        ImageBuilder(store).build(str(kf), ctx, "r:v1")
+        assert open(os.path.join(store.rootfs("r:v1"), "marker.txt")).read() \
+            == "built\n"
+
+    def test_run_failure_raises_with_output(self, store, ctx, tmp_path):
+        kf = tmp_path / "Kukefile"
+        kf.write_text("FROM scratch\nRUN false\n")
+        with pytest.raises(InvalidArgument, match="RUN"):
+            ImageBuilder(store).build(str(kf), ctx, "f:v1")
+
+    def test_copy_escape_rejected(self, store, ctx, tmp_path):
+        kf = tmp_path / "Kukefile"
+        kf.write_text("FROM scratch\nCOPY ../../etc/passwd /pw\n")
+        with pytest.raises(InvalidArgument, match="escapes"):
+            ImageBuilder(store).build(str(kf), ctx, "e:v1")
+
+    def test_missing_base_errors(self, store, ctx, tmp_path):
+        kf = tmp_path / "Kukefile"
+        kf.write_text("FROM nope:v9\n")
+        with pytest.raises(NotFound):
+            ImageBuilder(store).build(str(kf), ctx, "x:v1")
+
+
+class TestImageBackedCell:
+    def test_container_inherits_image_runtime(self, tmp_path):
+        rp = str(tmp_path / "rp")
+        istore = ImageStore(rp)
+        istore.put(ImageManifest(
+            name="tool", tag="v1",
+            entrypoint=["/bin/sh", "-c", "echo from-image"],
+            env={"IMG_ENV": "yes"}, workdir="/tmp",
+        ))
+        store = ResourceStore(MetadataStore(rp))
+        backend = FakeBackend()
+        runner = Runner(store, backend)
+        ctl = Controller(store, runner)
+        ctl.bootstrap()
+        doc = t.Document(
+            kind=t.KIND_CELL,
+            metadata=t.Metadata(name="c1", realm=consts.DEFAULT_REALM,
+                                space=consts.DEFAULT_SPACE,
+                                stack=consts.DEFAULT_STACK),
+            spec=t.CellSpec(containers=[
+                t.ContainerSpec(name="main", image="tool:v1"),
+            ]),
+        )
+        ctl.create_cell(doc)
+        ctx = backend.started[-1]
+        assert ctx.command == ["/bin/sh", "-c", "echo from-image"]
+        assert ctx.env["IMG_ENV"] == "yes"
+        assert ctx.env["KUKEON_IMAGE"] == "tool:v1"
+        assert ctx.workdir == "/tmp"
+
+    def test_spec_args_replace_image_cmd_keep_entrypoint(self, tmp_path):
+        rp = str(tmp_path / "rp")
+        ImageStore(rp).put(ImageManifest(name="tool", tag="v1",
+                                         entrypoint=["/bin/app"],
+                                         cmd=["--serve"]))
+        store = ResourceStore(MetadataStore(rp))
+        backend = FakeBackend()
+        ctl = Controller(store, Runner(store, backend))
+        ctl.bootstrap()
+        doc = t.Document(
+            kind=t.KIND_CELL,
+            metadata=t.Metadata(name="c3", realm=consts.DEFAULT_REALM,
+                                space=consts.DEFAULT_SPACE,
+                                stack=consts.DEFAULT_STACK),
+            spec=t.CellSpec(containers=[
+                t.ContainerSpec(name="main", image="tool:v1",
+                                args=["--migrate"]),
+            ]),
+        )
+        ctl.create_cell(doc)
+        assert backend.started[-1].command == ["/bin/app", "--migrate"]
+
+    def test_build_with_relative_context_dir(self, store, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        os.makedirs("relctx")
+        with open("relctx/f.txt", "w") as f:
+            f.write("x")
+        kf = tmp_path / "Kukefile"
+        kf.write_text("FROM scratch\nCOPY f.txt /f.txt\n")
+        ImageBuilder(store).build(str(kf), "relctx", "rel:v1")
+        assert os.path.exists(os.path.join(store.rootfs("rel:v1"), "f.txt"))
+
+    def test_spec_command_wins_over_image(self, tmp_path):
+        rp = str(tmp_path / "rp")
+        ImageStore(rp).put(ImageManifest(name="tool", tag="v1",
+                                         entrypoint=["/bin/img"]))
+        store = ResourceStore(MetadataStore(rp))
+        backend = FakeBackend()
+        ctl = Controller(store, Runner(store, backend))
+        ctl.bootstrap()
+        doc = t.Document(
+            kind=t.KIND_CELL,
+            metadata=t.Metadata(name="c2", realm=consts.DEFAULT_REALM,
+                                space=consts.DEFAULT_SPACE,
+                                stack=consts.DEFAULT_STACK),
+            spec=t.CellSpec(containers=[
+                t.ContainerSpec(name="main", image="tool:v1",
+                                command=["/bin/mine"]),
+            ]),
+        )
+        ctl.create_cell(doc)
+        assert backend.started[-1].command == ["/bin/mine"]

@@ -133,6 +133,14 @@ def agents_repo(tmp_path):
     (repo / "harnesses" / "claude" / "harness.yaml").write_text(HARNESS_YAML)
     (repo / "harnesses" / "claude" / "blueprint.yaml.j2").write_text(TEMPLATE)
     (repo / "harnesses" / "images.yaml").write_text(IMAGES_YAML)
+    (repo / "images" / "basic").mkdir(parents=True)
+    (repo / "images" / "basic" / "Kukefile").write_text(
+        "FROM scratch\nENV LAYER=basic\n"
+    )
+    (repo / "images" / "py").mkdir(parents=True)
+    (repo / "images" / "py" / "Kukefile").write_text(
+        "ARG REGISTRY\nFROM kukeon.internal/claude-basic:v1\nENV LAYER=py\n"
+    )
     _git(repo, "init", "-q", "-b", "main")
     _git(repo, "add", ".")
     _git(repo, "commit", "-q", "-m", "v1")
@@ -242,6 +250,17 @@ class TestSecrets:
             f.write("API_KEY=per-team\n")
         vals = load_team_secrets(team_host, cfg, "myproj")
         assert vals == {"api-key": "per-team"}
+
+    def test_scaffolded_empty_per_team_key_does_not_mask_shared(self, team_host):
+        """First init scaffolds `API_KEY=` per-team; a filled shared layer
+        must still win on the next init."""
+        cfg = team_host.load_config()
+        load_team_secrets(team_host, cfg, "myproj")   # scaffolds empty key
+        os.makedirs(os.path.dirname(team_host.shared_secrets_path()), exist_ok=True)
+        with open(team_host.shared_secrets_path(), "w") as f:
+            f.write("API_KEY=from-shared\n")
+        assert load_team_secrets(team_host, cfg, "myproj") \
+            == {"api-key": "from-shared"}
 
     def test_scaffolds_missing_keys_0600(self, team_host):
         cfg = team_host.load_config()
@@ -418,6 +437,22 @@ class TestTeamInit:
         assert ("CellConfig", "myproj-coder-claude") in pruned
         assert ("CellBlueprint", "myproj-coder-claude") in pruned
         assert ("Secret", "api-key") not in pruned   # still in the roster
+
+    def test_build_walks_from_order(self, tmp_path, team_host):
+        """--build: bases build before leaves regardless of catalog order."""
+        from kukeon_tpu.runtime.images import ImageBuilder, ImageStore
+
+        project_file = tmp_path / "team.yaml"
+        project_file.write_text(PROJECT_YAML)
+        store = ImageStore(str(tmp_path / "rp"))
+        res = team_init(None, str(project_file), host=team_host,
+                        dry_run=True, build=True,
+                        builder=ImageBuilder(store))
+        assert res.built_images == ["kukeon.internal/claude-basic:v1",
+                                    "kukeon.internal/claude-py:v1"]
+        py = store.get("kukeon.internal/claude-py:v1")
+        assert py.parent == "kukeon.internal/claude-basic:v1"
+        assert py.env["LAYER"] == "py"
 
     def test_dry_run_touches_nothing(self, tmp_path, team_host):
         project_file = tmp_path / "team.yaml"
