@@ -75,6 +75,7 @@ class ProcessBackend(CellBackend):
                 "entrypoint"
             )
         ctx.command = self._overlay_command(ctx)
+        ctx.workdir = self._overlay_workdir(ctx)
         p = self.paths(ctx)
         os.makedirs(ctx.container_dir, exist_ok=True)
         # A fresh start invalidates previous run artifacts.
@@ -199,6 +200,21 @@ class ProcessBackend(CellBackend):
                     continue
             out.append(arg)
         return out
+
+    @staticmethod
+    def _overlay_workdir(ctx: ContainerContext) -> str | None:
+        """For an image-backed container, an absolute workdir ALWAYS names an
+        in-image path (OCI semantics): resolve it inside the rootfs, creating
+        it on demand (builders commonly WORKDIR a dir no instruction made).
+        Host-dir fallbacks are deliberately not attempted — /srv or /opt
+        existing on the host must not shadow the image's own tree."""
+        wd = ctx.workdir
+        rootfs = ctx.env.get("KUKEON_IMAGE_ROOTFS")
+        if not wd or not rootfs or not wd.startswith("/"):
+            return wd
+        candidate = os.path.join(rootfs, wd.lstrip("/"))
+        os.makedirs(candidate, exist_ok=True)
+        return candidate
 
     def _reap(self) -> None:
         """Collect any finished supervisors we spawned (avoid zombies)."""

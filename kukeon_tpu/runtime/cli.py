@@ -341,15 +341,26 @@ def cmd_get(args):
             if args.json:
                 _print(rows, True)
             else:
-                fmt = "{:<24} {:<10} {:<28} {:<9} {}"
-                print(fmt.format("NAME", "PHASE", "SCOPE", "CHIPS", "CONTAINERS"))
+                fmt = "{:<24} {:<10} {:<28} {:<9} {:<10} {}"
+                print(fmt.format("NAME", "PHASE", "SCOPE", "CHIPS", "SYNC", "CONTAINERS"))
                 for r in rows:
                     scope = f"{r['realm']}/{r['space']}/{r['stack']}"
                     chips = ",".join(map(str, r["status"].get("tpuChips", []))) or "-"
+                    st = r["status"]
+                    # SYNC column mirrors the reference's three-way verdict:
+                    # config-lineage cells show Synced/OutOfSync/Error, others "-".
+                    if st.get("outOfSyncError"):
+                        sync = "Error"
+                    elif st.get("outOfSync"):
+                        sync = "OutOfSync"
+                    elif (r.get("provenance") or {}).get("config"):
+                        sync = "Synced"
+                    else:
+                        sync = "-"
                     conts = ",".join(
-                        f"{cs['name']}:{cs['state']}" for cs in r["status"]["containers"]
+                        f"{cs['name']}:{cs['state']}" for cs in st["containers"]
                     )
-                    print(fmt.format(r["name"], r["status"]["phase"], scope, chips, conts))
+                    print(fmt.format(r["name"], st["phase"], scope, chips, sync, conts))
     elif kind in ("secrets", "secret"):
         for x in c.call("ListSecrets", realm=s["realm"], space=args.space, stack=args.stack):
             print(x)

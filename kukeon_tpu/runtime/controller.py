@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import re
 import time
 from typing import Any
 
@@ -20,9 +21,15 @@ from kukeon_tpu.runtime.apply import parser, scheme
 from kukeon_tpu.runtime.errors import (
     FailedPrecondition,
     InvalidArgument,
+    KukeonError,
     NotFound,
 )
-from kukeon_tpu.runtime.runner import OUTCOME_STEADY, Runner
+from kukeon_tpu.runtime.runner import (
+    OUTCOME_AUTO_DELETED,
+    OUTCOME_STEADY,
+    OUTCOME_VANISHED,
+    Runner,
+)
 from kukeon_tpu.runtime.store import ResourceStore
 
 BREAKING = "breaking"
@@ -473,15 +480,11 @@ class Controller:
 
     # --- blueprint/config materialization ----------------------------------
 
-    def materialize_config(self, realm: str, space: str | None, stack: str | None,
-                           config_name: str) -> dict:
-        """CellConfig -> live cell (reference: cellconfig/materialize.go)."""
-        cfg_doc = self.store.resolve_scoped(
-            consts.CONFIGS_DIR, realm, space, stack, config_name
-        )
-        if cfg_doc is None:
-            raise NotFound(f"cellconfig {config_name!r} not found")
-        cfg = from_wire(t.CellConfigSpec, cfg_doc["spec"])
+    def _materialize_spec(self, realm: str, space: str | None, stack: str | None,
+                          cfg: t.CellConfigSpec) -> t.CellSpec:
+        """Config + referenced blueprint -> would-be cell spec. Shared by
+        materialize_config and the OutOfSync re-derivation so both always
+        agree (reference: cellconfig/materialize.go:63-317)."""
         bp = self.get_blueprint(realm, space, stack, cfg.blueprint)
         cell_spec = substitute_blueprint(bp, cfg.values)
         # Bind config env overlay + secret slots.
@@ -494,6 +497,18 @@ class Controller:
                     if s.name == binding.slot else s
                     for s in c.secrets
                 ]
+        return cell_spec
+
+    def materialize_config(self, realm: str, space: str | None, stack: str | None,
+                           config_name: str) -> dict:
+        """CellConfig -> live cell (reference: cellconfig/materialize.go)."""
+        cfg_doc = self.store.resolve_scoped(
+            consts.CONFIGS_DIR, realm, space, stack, config_name
+        )
+        if cfg_doc is None:
+            raise NotFound(f"cellconfig {config_name!r} not found")
+        cfg = from_wire(t.CellConfigSpec, cfg_doc["spec"])
+        cell_spec = self._materialize_spec(realm, space, stack, cfg)
         # A config represents exactly ONE live cell, so the default name is
         # the config's own name — deterministic across applies (a random
         # name here would mint a fresh cell every apply; fresh-cell-per-run
@@ -540,13 +555,70 @@ class Controller:
     # --- reconcile (reference: reconcile.go:52-206) ------------------------
 
     def images_in_use(self) -> set[str]:
-        """Image refs referenced by any cell container spec (prune keep-set)."""
+        """Image refs referenced by any cell container spec OR any stored
+        CellBlueprint's container template (prune keep-set). Blueprints count
+        because a config may materialize a cell from them at any time; prune
+        must not strand that future cell without its image."""
         out: set[str] = set()
         for realm in self.store.list_realms():
             for rec in self.list_cells(realm):
                 for c in rec.get("spec", {}).get("containers", []):
                     if c.get("image"):
                         out.add(c["image"])
+            scopes: list[tuple[str | None, str | None]] = [(None, None)]
+            for space in self.store.list_spaces(realm):
+                scopes.append((space, None))
+                for stack in self.store.list_stacks(realm, space):
+                    scopes.append((space, stack))
+            def blueprint_refs(doc: dict, values: dict[str, str]) -> set[str]:
+                """Image refs a blueprint doc would materialize under the
+                given param values (param defaults fill the gaps). A ref
+                still templated after substitution can't name a concrete
+                image and is skipped."""
+                params = {
+                    p.get("name"): p.get("default")
+                    for p in doc.get("spec", {}).get("params", []) or []
+                    if p.get("default") is not None
+                }
+                params.update(values)
+                refs: set[str] = set()
+                for c in (doc.get("spec", {}).get("cell", {}) or {}).get(
+                        "containers", []):
+                    ref = c.get("image")
+                    if not ref:
+                        continue
+                    if "${" in ref:
+                        ref = re.sub(
+                            r"\$\{([A-Za-z0-9_.-]+)\}",
+                            lambda m: str(params.get(m.group(1), m.group(0))),
+                            ref,
+                        )
+                        if "${" in ref:
+                            continue
+                    refs.add(ref)
+                return refs
+
+            for space, stack in scopes:
+                for name in self.store.list_scoped(
+                        consts.BLUEPRINTS_DIR, realm, space, stack):
+                    doc = self.store.read_scoped(
+                        consts.BLUEPRINTS_DIR, realm, space, stack, name)
+                    if doc:
+                        out |= blueprint_refs(doc, {})
+                # Stored configs may override params (values: {img: ...});
+                # the images THEY would materialize must survive prune too.
+                for name in self.store.list_scoped(
+                        consts.CONFIGS_DIR, realm, space, stack):
+                    cfg_doc = self.store.read_scoped(
+                        consts.CONFIGS_DIR, realm, space, stack, name)
+                    if not cfg_doc:
+                        continue
+                    spec = cfg_doc.get("spec", {}) or {}
+                    bp_doc = self.store.resolve_scoped(
+                        consts.BLUEPRINTS_DIR, realm, space, stack,
+                        spec.get("blueprint") or "")
+                    if bp_doc:
+                        out |= blueprint_refs(bp_doc, dict(spec.get("values") or {}))
         return out
 
     def reconcile_space_networks(self) -> dict[str, dict]:
@@ -562,9 +634,77 @@ class Controller:
             for space in self.store.list_spaces(realm):
                 for stack in self.store.list_stacks(realm, space):
                     for cell in self.store.list_cells(realm, space, stack):
-                        _, outcome = self.runner.refresh_cell(realm, space, stack, cell)
-                        counts[outcome] = counts.get(outcome, 0) + 1
+                        # One broken cell (stale image ref, corrupt metadata)
+                        # must not stall reconciliation for every cell after
+                        # it in iteration order.
+                        try:
+                            rec, outcome = self.runner.refresh_cell(realm, space, stack, cell)
+                            counts[outcome] = counts.get(outcome, 0) + 1
+                            # A cell refresh just deleted must not get its
+                            # record resurrected by an out-of-sync write.
+                            if (rec is not None
+                                    and outcome not in (OUTCOME_AUTO_DELETED,
+                                                        OUTCOME_VANISHED)
+                                    and self._reconcile_out_of_sync(rec)):
+                                counts["out_of_sync"] = counts.get("out_of_sync", 0) + 1
+                        except (KukeonError, OSError):
+                            counts["error"] = counts.get("error", 0) + 1
         return counts
+
+    def _reconcile_out_of_sync(self, rec: model.CellRecord) -> bool:
+        """Per-cell OutOfSync detection for Config-lineage cells (reference:
+        reconcile_outofsync.go:38-160). Re-derives the would-be spec from the
+        stored Config + Blueprint and diffs it against the live spec. Three
+        outcomes land on status: out_of_sync+reason (drift, or Config
+        deleted), clean (synced), or out_of_sync_error (undecidable:
+        blueprint missing / materialize failure). Persists only on change;
+        returns True when the cell is currently out of sync."""
+        config_name = (rec.provenance.config or "").strip()
+        if not config_name:
+            return False
+
+        out_of_sync, reason, error = False, None, None
+        cfg_doc = self.store.resolve_scoped(
+            consts.CONFIGS_DIR, rec.realm, rec.space, rec.stack, config_name
+        )
+        if cfg_doc is None:
+            out_of_sync, reason = True, "lineage Config deleted"
+        else:
+            try:
+                cfg = from_wire(t.CellConfigSpec, cfg_doc["spec"])
+                spec = self._materialize_spec(rec.realm, rec.space, rec.stack, cfg)
+                # Normalize through the same path materialize_config's cell
+                # took at create time, so defaulting never reads as drift.
+                desired = scheme.normalize(t.Document(
+                    kind=t.KIND_CELL,
+                    metadata=t.Metadata(name=rec.name, realm=rec.realm,
+                                        space=rec.space, stack=rec.stack),
+                    spec=spec,
+                )).spec
+                verdict = diff_cell_spec(desired, rec.spec)
+                if verdict != UNCHANGED:
+                    out_of_sync, reason = True, f"spec differs ({verdict})"
+            except KukeonError as e:
+                error = str(e)
+
+        st = rec.status
+        if (st.out_of_sync, st.out_of_sync_reason, st.out_of_sync_error) == \
+                (out_of_sync, reason, error):
+            return out_of_sync
+        # Persist under the cell lock against a FRESH read: a concurrent RPC
+        # (stop/apply) may have written the record since our refresh snapshot,
+        # and writing the stale rec back would undo it (e.g. flip a stopped
+        # cell back to desired_state=running).
+        with self.runner.cell_lock(rec.realm, rec.space, rec.stack, rec.name):
+            try:
+                fresh = self.store.read_cell(rec.realm, rec.space, rec.stack, rec.name)
+            except NotFound:
+                return out_of_sync
+            fresh.status.out_of_sync = out_of_sync
+            fresh.status.out_of_sync_reason = reason
+            fresh.status.out_of_sync_error = error
+            self.store.write_cell(fresh)
+        return out_of_sync
 
     # --- helpers -----------------------------------------------------------
 

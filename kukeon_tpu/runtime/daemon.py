@@ -28,7 +28,12 @@ from kukeon_tpu.runtime.cells import ProcessBackend
 from kukeon_tpu.runtime.cgroups import CgroupManager
 from kukeon_tpu.runtime.controller import Controller
 from kukeon_tpu.runtime.devices import TPUDeviceManager
-from kukeon_tpu.runtime.errors import InvalidArgument, KukeonError, NotFound
+from kukeon_tpu.runtime.errors import (
+    FailedPrecondition,
+    InvalidArgument,
+    KukeonError,
+    NotFound,
+)
 from kukeon_tpu.runtime.metadata import MetadataStore
 from kukeon_tpu.runtime.runner import Runner
 from kukeon_tpu.runtime.store import ResourceStore
@@ -258,6 +263,17 @@ class RPCService:
         return self._image_store().get(ref).to_json()
 
     def DeleteImage(self, ref: str) -> None:
+        from kukeon_tpu.runtime.images import split_ref
+
+        # In-use guard: deleting an image a cell still references would brick
+        # that cell's next restart (its container context can't resolve).
+        want = "%s:%s" % split_ref(ref)
+        in_use = {"%s:%s" % split_ref(r) for r in self.ctl.images_in_use()}
+        if want in in_use:
+            raise FailedPrecondition(
+                f"image {ref!r} is referenced by a cell spec; "
+                "delete the cell first or use prune"
+            )
         self._image_store().delete(ref)
 
     def PruneImages(self) -> list[str]:

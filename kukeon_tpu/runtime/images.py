@@ -43,6 +43,7 @@ import re
 import shlex
 import shutil
 import subprocess
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -143,29 +144,89 @@ class ImageStore:
         os.replace(tmp, os.path.join(d, "manifest.json"))
         return d
 
+    def stage(self, ref: str) -> str:
+        """A fresh, caller-private staging bundle dir (with empty rootfs/)
+        for ref. Build or import into it, then commit(); a failure before
+        commit never touches the live image at the same tag. The name is
+        uniquified so concurrent builds/loads of the same ref can't destroy
+        each other's staging mid-write (last commit wins)."""
+        staging = os.path.join(
+            self.root,
+            f".staging-{encode_ref(ref)}-{os.getpid()}-{time.monotonic_ns()}",
+        )
+        os.makedirs(os.path.join(staging, "rootfs"))
+        return staging
+
+    # Serializes the swap step of commit() across daemon RPC threads; the
+    # expensive build/extract work stays parallel (each in its own staging).
+    _commit_lock = threading.Lock()
+
+    def commit(self, manifest: ImageManifest, staging: str) -> str:
+        """Atomically promote a staged bundle to the live image dir: the old
+        bundle (stale rootfs included) is swapped out whole, never merged.
+        Concurrent commits of the same ref serialize; last one wins.
+
+        The displaced bundle is RENAMED to ``<dir>.old-*`` and kept, not
+        deleted: a running cell started from the previous image may hold its
+        cwd (and open files) inside that rootfs, and deleting it would yank
+        the directory out from under a live workload. gc_old() reaps the
+        renamed bundles later (prune / delete call it)."""
+        manifest.created_at = manifest.created_at or time.time()
+        with open(os.path.join(staging, "manifest.json"), "w") as f:
+            json.dump(manifest.to_json(), f, indent=2)
+        d = self._dir(manifest.ref)
+        old = f"{d}.old-{os.getpid()}-{time.monotonic_ns()}"
+        with self._commit_lock:
+            try:
+                os.rename(d, old)
+            except FileNotFoundError:
+                pass   # no previous image at this tag
+            os.rename(staging, d)
+        return d
+
+    def gc_old(self) -> int:
+        """Remove bundles displaced by rebuilds (``*.old-*``). Safe to call
+        when no cell is mid-flight on a pre-rebuild image; wired into prune
+        and delete, which already imply operator-driven cleanup."""
+        if not os.path.isdir(self.root):
+            return 0
+        n = 0
+        for entry in os.listdir(self.root):
+            if ".old-" in entry:
+                shutil.rmtree(os.path.join(self.root, entry), ignore_errors=True)
+                n += 1
+        return n
+
+    def abort(self, staging: str) -> None:
+        shutil.rmtree(staging, ignore_errors=True)
+
     def delete(self, ref: str) -> None:
         if not self.exists(ref):
             raise NotFound(f"image {ref!r} not found")
         shutil.rmtree(self._dir(ref), ignore_errors=True)
+        self.gc_old()
 
     def prune(self, in_use: set[str]) -> list[str]:
         """Delete images not referenced by any cell spec; returns refs
         removed. Parents of in-use images are kept (FROM chains stay
-        rebuildable)."""
+        rebuildable). in_use refs are normalized (bare ``tool`` == the
+        stored ``tool:latest``) so spec shorthand never loses an image."""
         keep = set()
         for ref in in_use:
-            cur = ref
+            cur = "%s:%s" % split_ref(ref)
             while cur and cur not in keep:
                 keep.add(cur)
                 try:
                     cur = self.get(cur).parent
                 except NotFound:
                     break
+                cur = "%s:%s" % split_ref(cur) if cur else cur
         removed = []
         for m in self.list():
             if m.ref not in keep:
                 self.delete(m.ref)
                 removed.append(m.ref)
+        self.gc_old()
         return removed
 
     # --- tar import/export (kuke image load / save) -------------------------
@@ -184,33 +245,43 @@ class ImageStore:
 
         name, tag = split_ref(ref)
         m = ImageManifest(name=name, tag=tag)
-        d = self.put(m)
-        rootfs = os.path.join(d, "rootfs")
-        with tarfile.open(tar_path) as tf:
-            names = tf.getnames()
-            structured = any(
-                n == self._TAR_ROOTFS or n.startswith(self._TAR_ROOTFS + "/")
-                for n in names
-            )
-            if structured:
-                tf.extractall(d, filter="data",
-                              members=[mem for mem in tf.getmembers()
-                                       if mem.name == self._TAR_ROOTFS
-                                       or mem.name.startswith(self._TAR_ROOTFS + "/")])
-                meta_member = next(
-                    (mem for mem in tf.getmembers()
-                     if mem.name == self._TAR_META), None
+        staging = self.stage(m.ref)
+        try:
+            rootfs = os.path.join(staging, "rootfs")
+
+            def norm(n: str) -> str:
+                # `tar -cf x.tar -C bundle .` produces ./-prefixed members;
+                # they must still match the structured layout.
+                return n[2:] if n.startswith("./") else n
+
+            with tarfile.open(tar_path) as tf:
+                names = [norm(n) for n in tf.getnames()]
+                structured = any(
+                    n == self._TAR_ROOTFS or n.startswith(self._TAR_ROOTFS + "/")
+                    for n in names
                 )
-                if meta_member is not None:
-                    meta = json.load(tf.extractfile(meta_member))
-                    m.entrypoint = list(meta.get("entrypoint") or [])
-                    m.cmd = list(meta.get("cmd") or [])
-                    m.env = dict(meta.get("env") or {})
-                    m.workdir = meta.get("workdir", "")
-                    m.labels = dict(meta.get("labels") or {})
-            else:
-                tf.extractall(rootfs, filter="data")
-        self.put(m)
+                if structured:
+                    tf.extractall(staging, filter="data",
+                                  members=[mem for mem in tf.getmembers()
+                                           if norm(mem.name) == self._TAR_ROOTFS
+                                           or norm(mem.name).startswith(self._TAR_ROOTFS + "/")])
+                    meta_member = next(
+                        (mem for mem in tf.getmembers()
+                         if norm(mem.name) == self._TAR_META), None
+                    )
+                    if meta_member is not None:
+                        meta = json.load(tf.extractfile(meta_member))
+                        m.entrypoint = list(meta.get("entrypoint") or [])
+                        m.cmd = list(meta.get("cmd") or [])
+                        m.env = dict(meta.get("env") or {})
+                        m.workdir = meta.get("workdir", "")
+                        m.labels = dict(meta.get("labels") or {})
+                else:
+                    tf.extractall(rootfs, filter="data")
+        except BaseException:
+            self.abort(staging)
+            raise
+        self.commit(m, staging)
         return m
 
     def save_tar(self, ref: str, tar_path: str) -> None:
@@ -246,10 +317,18 @@ def parse_kukefile(text: str, origin: str = "Kukefile") -> list[Instruction]:
     out = []
     continuation = ""
     for lineno, raw in enumerate(text.splitlines(), 1):
-        line = continuation + raw.strip()
+        stripped = raw.strip()
+        if continuation:
+            # Docker semantics inside a continuation: comment lines are
+            # skipped, blank lines dropped — neither terminates it.
+            if not stripped or stripped.startswith("#"):
+                continue
+            line = continuation + stripped
+        else:
+            if not stripped or stripped.startswith("#"):
+                continue
+            line = stripped
         continuation = ""
-        if not line or line.startswith("#"):
-            continue
         if line.endswith("\\"):
             continuation = line[:-1].rstrip() + " "
             continue
@@ -269,6 +348,21 @@ def _subst(value: str, vars_: dict[str, str]) -> str:
         key = m.group(1) or m.group(2)
         return vars_.get(key, "")
     return _VAR_RE.sub(repl, value)
+
+
+def _parse_kv(rest: str, op: str) -> tuple[str, str]:
+    """ENV/LABEL value: `KEY=VALUE` or the Dockerfile space form `KEY value`.
+    A lone key with neither separator is a build error, not a silent empty."""
+    rest = rest.strip()
+    if not rest:
+        raise InvalidArgument(f"{op} wants KEY=VALUE or KEY value")
+    if "=" in rest.split(None, 1)[0]:
+        k, _, v = rest.partition("=")
+        return k.strip(), v.strip()
+    k, _, v = rest.partition(" ")
+    if not v.strip():
+        raise InvalidArgument(f"{op} wants KEY=VALUE or KEY value: {rest!r}")
+    return k.strip(), v.strip()
 
 
 def _parse_exec_form(rest: str) -> list[str]:
@@ -317,8 +411,20 @@ class ImageBuilder:
         name, tag_ = split_ref(tag)
         m = ImageManifest(name=name, tag=tag_)
         vars_ = dict(build_args or {})
-        d = self.store.put(m)
-        rootfs = os.path.join(d, "rootfs")
+        staging = self.store.stage(m.ref)
+        try:
+            self._run_instructions(m, instrs, staging, context_dir, vars_,
+                                   kukefile_path)
+        except BaseException:
+            self.store.abort(staging)
+            raise
+        self.store.commit(m, staging)
+        return m
+
+    def _run_instructions(self, m: ImageManifest, instrs: list[Instruction],
+                          staging: str, context_dir: str,
+                          vars_: dict[str, str], kukefile_path: str) -> None:
+        rootfs = os.path.join(staging, "rootfs")
         seen_from = False
 
         for ins in instrs:
@@ -352,20 +458,23 @@ class ImageBuilder:
                 src = os.path.abspath(os.path.join(ctx_abs, parts[0]))
                 if src != ctx_abs and not src.startswith(ctx_abs + os.sep):
                     raise InvalidArgument(f"COPY src escapes context: {parts[0]!r}")
-                dst = os.path.join(rootfs, parts[1].lstrip("/"))
+                rootfs_abs = os.path.abspath(rootfs)
+                dst = os.path.abspath(os.path.join(rootfs_abs, parts[1].lstrip("/")))
+                if dst != rootfs_abs and not dst.startswith(rootfs_abs + os.sep):
+                    raise InvalidArgument(f"COPY dst escapes rootfs: {parts[1]!r}")
                 if os.path.isdir(src):
                     shutil.copytree(src, dst, dirs_exist_ok=True, symlinks=True)
                 else:
                     os.makedirs(os.path.dirname(dst), exist_ok=True)
                     shutil.copy2(src, dst)
             elif ins.op == "ENV":
-                k, _, v = _subst(rest, vars_).partition("=")
-                m.env[k.strip()] = v.strip()
+                k, v = _parse_kv(_subst(rest, vars_), "ENV")
+                m.env[k] = v
             elif ins.op == "WORKDIR":
                 m.workdir = _subst(rest, vars_).strip()
             elif ins.op == "LABEL":
-                k, _, v = _subst(rest, vars_).partition("=")
-                m.labels[k.strip()] = v.strip()
+                k, v = _parse_kv(_subst(rest, vars_), "LABEL")
+                m.labels[k] = v
             elif ins.op == "RUN":
                 cmd = _parse_exec_form(_subst(rest, vars_))
                 env = {**os.environ, **m.env, "KUKEON_BUILD_ROOT": rootfs}
@@ -381,6 +490,3 @@ class ImageBuilder:
                 m.entrypoint = _parse_exec_form(_subst(rest, vars_))
             elif ins.op == "CMD":
                 m.cmd = _parse_exec_form(_subst(rest, vars_))
-
-        self.store.put(m)
-        return m

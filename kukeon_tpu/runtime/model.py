@@ -54,6 +54,13 @@ class CellStatus:
     containers: list[ContainerStatus] = field(default_factory=list)
     observed_generation: int = 0
     tpu_chips: list[int] = field(default_factory=list)   # chips granted
+    # OutOfSync detection for Config-lineage cells (reference:
+    # internal/controller/reconcile_outofsync.go:38-160). out_of_sync_error
+    # marks an UNDECIDABLE verdict (blueprint missing, materialize failure)
+    # and is distinct from out_of_sync so `get cell` can route it separately.
+    out_of_sync: bool = False
+    out_of_sync_reason: str | None = None
+    out_of_sync_error: str | None = None
 
     def container(self, name: str) -> ContainerStatus | None:
         for c in self.containers:

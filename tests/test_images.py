@@ -238,3 +238,313 @@ class TestImageBackedCell:
         )
         ctl.create_cell(doc)
         assert backend.started[-1].command == ["/bin/mine"]
+
+
+class TestBuildSafety:
+    """Regressions for the review findings: dst traversal, atomic builds,
+    stale-rootfs merging, prune ref normalization."""
+
+    @pytest.fixture
+    def ctx(self, tmp_path):
+        c = tmp_path / "ctx"
+        c.mkdir()
+        (c / "app.sh").write_text("#!/bin/sh\necho app\n")
+        return str(c)
+
+    def test_copy_dst_escape_rejected(self, store, ctx, tmp_path):
+        kf = tmp_path / "Kukefile"
+        kf.write_text("FROM scratch\nCOPY app.sh ../../escape.sh\n")
+        outside = tmp_path / "escape.sh"
+        with pytest.raises(InvalidArgument, match="dst escapes"):
+            ImageBuilder(store).build(str(kf), ctx, "bad:v1")
+        assert not outside.exists()
+        assert not store.exists("bad:v1")
+
+    def test_failed_build_preserves_previous_image(self, store, ctx, tmp_path):
+        good = tmp_path / "Good"
+        good.write_text('FROM scratch\nENV V=1\nENTRYPOINT ["/bin/true"]\n')
+        ImageBuilder(store).build(str(good), ctx, "app:v1")
+
+        bad = tmp_path / "Bad"
+        bad.write_text("FROM scratch\nRUN exit 9\n")
+        with pytest.raises(InvalidArgument, match="failed"):
+            ImageBuilder(store).build(str(bad), ctx, "app:v1")
+        # Old image survives untouched, no staging leftovers.
+        m = store.get("app:v1")
+        assert m.env == {"V": "1"}
+        assert m.entrypoint == ["/bin/true"]
+        assert not [e for e in os.listdir(store.root) if e.startswith(".staging")]
+
+    def test_rebuild_replaces_rootfs_wholesale(self, store, ctx, tmp_path):
+        kf1 = tmp_path / "K1"
+        kf1.write_text("FROM scratch\nCOPY app.sh /bin/old.sh\n")
+        ImageBuilder(store).build(str(kf1), ctx, "app:v1")
+        assert os.path.exists(os.path.join(store.rootfs("app:v1"), "bin/old.sh"))
+
+        kf2 = tmp_path / "K2"
+        kf2.write_text("FROM scratch\nCOPY app.sh /bin/new.sh\n")
+        ImageBuilder(store).build(str(kf2), ctx, "app:v1")
+        rootfs = store.rootfs("app:v1")
+        assert os.path.exists(os.path.join(rootfs, "bin/new.sh"))
+        assert not os.path.exists(os.path.join(rootfs, "bin/old.sh"))
+
+    def test_reload_tar_replaces_rootfs(self, store, tmp_path):
+        d1 = tmp_path / "t1"
+        d1.mkdir()
+        (d1 / "a.txt").write_text("a")
+        tar1 = tmp_path / "t1.tar"
+        with tarfile.open(tar1, "w") as tf:
+            tf.add(d1 / "a.txt", arcname="a.txt")
+        store.load_tar(str(tar1), "img:v1")
+        assert os.path.exists(os.path.join(store.rootfs("img:v1"), "a.txt"))
+
+        d2 = tmp_path / "t2"
+        d2.mkdir()
+        (d2 / "b.txt").write_text("b")
+        tar2 = tmp_path / "t2.tar"
+        with tarfile.open(tar2, "w") as tf:
+            tf.add(d2 / "b.txt", arcname="b.txt")
+        store.load_tar(str(tar2), "img:v1")
+        rootfs = store.rootfs("img:v1")
+        assert os.path.exists(os.path.join(rootfs, "b.txt"))
+        assert not os.path.exists(os.path.join(rootfs, "a.txt"))
+
+    def test_prune_normalizes_bare_refs(self, store):
+        store.put(ImageManifest(name="tool", tag="latest"))
+        # A cell spec saying `image: tool` must keep tool:latest.
+        removed = store.prune(in_use={"tool"})
+        assert removed == []
+        assert store.exists("tool:latest")
+
+    def test_reconcile_survives_stale_image_ref(self, tmp_path):
+        """One cell with a deleted image must not stall reconciliation for
+        the cells after it (review finding: uncaught NotFound aborted the
+        whole pass)."""
+        rp = str(tmp_path / "rp")
+        istore = ImageStore(rp)
+        istore.put(ImageManifest(name="tool", tag="v1", entrypoint=["/bin/true"]))
+        store = ResourceStore(MetadataStore(rp))
+        backend = FakeBackend()
+        runner = Runner(store, backend)
+        ctl = Controller(store, runner)
+        ctl.bootstrap()
+        for name in ("a-broken", "b-ok"):
+            ctl.create_cell(t.Document(
+                kind=t.KIND_CELL,
+                metadata=t.Metadata(name=name, realm=consts.DEFAULT_REALM,
+                                    space=consts.DEFAULT_SPACE,
+                                    stack=consts.DEFAULT_STACK),
+                spec=t.CellSpec(containers=[
+                    t.ContainerSpec(name="main", image="tool:v1",
+                                    restart_policy=t.RestartPolicy(
+                                        policy="always", backoff_seconds=0.0)),
+                ]),
+            ))
+        istore.delete("tool:v1")
+        # Exit both so refresh hits the restart path (image resolution).
+        for name in ("a-broken", "b-ok"):
+            backend.exit(store.container_dir(
+                consts.DEFAULT_REALM, consts.DEFAULT_SPACE,
+                consts.DEFAULT_STACK, name, "main"), 1)
+        counts = ctl.reconcile_cells()
+        # Both cells error on image resolution, but the pass completes and
+        # counts them instead of raising.
+        assert counts.get("error") == 2
+
+    def test_load_tar_with_dot_slash_prefix(self, store, tmp_path):
+        """`tar -cf x.tar -C bundle .` layouts (./rootfs/...) must import as
+        structured, not nest under rootfs/./rootfs."""
+        bundle = tmp_path / "bundle"
+        (bundle / "rootfs" / "bin").mkdir(parents=True)
+        (bundle / "rootfs" / "bin" / "x.sh").write_text("echo x")
+        (bundle / "kukeon-manifest.json").write_text(
+            '{"entrypoint": ["/bin/sh", "/bin/x.sh"], "env": {"A": "1"}}'
+        )
+        tar = tmp_path / "img.tar"
+        subprocess.run(["tar", "-cf", str(tar), "-C", str(bundle), "."], check=True)
+        m = store.load_tar(str(tar), "dotted:v1")
+        assert m.entrypoint == ["/bin/sh", "/bin/x.sh"]
+        assert m.env == {"A": "1"}
+        rootfs = store.rootfs("dotted:v1")
+        assert os.path.exists(os.path.join(rootfs, "bin/x.sh"))
+        assert not os.path.exists(os.path.join(rootfs, "rootfs"))
+
+    def test_blueprint_images_survive_prune(self, tmp_path):
+        """Images referenced only by a stored CellBlueprint template must be
+        kept by prune (a config can materialize from it at any time)."""
+        rp = str(tmp_path / "rp")
+        istore = ImageStore(rp)
+        istore.put(ImageManifest(name="bp-tool", tag="v1", entrypoint=["/bin/true"]))
+        istore.put(ImageManifest(name="orphan", tag="v1"))
+        store = ResourceStore(MetadataStore(rp))
+        ctl = Controller(store, Runner(store, FakeBackend()))
+        ctl.bootstrap()
+        ctl.put_blueprint(t.Document(
+            kind=t.KIND_CELL_BLUEPRINT, metadata=t.Metadata(name="bp"),
+            spec=t.CellBlueprintSpec(cell=t.CellSpec(containers=[
+                t.ContainerSpec(name="m", image="bp-tool:v1"),
+            ])),
+        ))
+        removed = istore.prune(ctl.images_in_use())
+        assert removed == ["orphan:v1"]
+        assert istore.exists("bp-tool:v1")
+
+
+class TestReviewRound3:
+    @pytest.fixture
+    def ctx(self, tmp_path):
+        c = tmp_path / "ctx"
+        c.mkdir()
+        (c / "app.sh").write_text("#!/bin/sh\necho app\n")
+        return str(c)
+
+    def test_env_label_space_form_and_lone_key_rejected(self, store, ctx, tmp_path):
+        kf = tmp_path / "K"
+        kf.write_text("FROM scratch\nENV MODE prod\nLABEL team demo\n")
+        m = ImageBuilder(store).build(str(kf), ctx, "sf:v1")
+        assert m.env == {"MODE": "prod"}
+        assert m.labels == {"team": "demo"}
+
+        kf.write_text("FROM scratch\nENV LONELY\n")
+        with pytest.raises(InvalidArgument, match="ENV wants"):
+            ImageBuilder(store).build(str(kf), ctx, "sf:v2")
+
+    def test_continuation_with_comment_and_blank_lines(self, tmp_path):
+        instrs = parse_kukefile(
+            "RUN echo a \\\n"
+            "# interleaved comment\n"
+            "\n"
+            "    b\n"
+        )
+        assert len(instrs) == 1
+        assert instrs[0].op == "RUN"
+        assert instrs[0].args == ["echo a b"]
+
+    def test_parameterized_blueprint_image_kept_by_prune(self, tmp_path):
+        rp = str(tmp_path / "rp")
+        istore = ImageStore(rp)
+        istore.put(ImageManifest(name="tool", tag="v1", entrypoint=["/bin/true"]))
+        store = ResourceStore(MetadataStore(rp))
+        ctl = Controller(store, Runner(store, FakeBackend()))
+        ctl.bootstrap()
+        ctl.put_blueprint(t.Document(
+            kind=t.KIND_CELL_BLUEPRINT, metadata=t.Metadata(name="bp"),
+            spec=t.CellBlueprintSpec(
+                params=[t.BlueprintParam(name="img", default="tool:v1")],
+                cell=t.CellSpec(containers=[
+                    t.ContainerSpec(name="m", image="${img}"),
+                ]),
+            ),
+        ))
+        assert "tool:v1" in ctl.images_in_use()
+        removed = istore.prune(ctl.images_in_use())
+        assert removed == []
+
+    def test_image_workdir_resolves_in_rootfs(self, tmp_path):
+        """Image WORKDIR /srv must chdir inside the rootfs (created on
+        demand), not on the host."""
+        rp = str(tmp_path / "rp")
+        istore = ImageStore(rp)
+        istore.put(ImageManifest(name="wd", tag="v1", entrypoint=["pwd"],
+                                 workdir="/srv-nonexistent-on-host"))
+        store = ResourceStore(MetadataStore(rp))
+        backend = FakeBackend()
+        ctl = Controller(store, Runner(store, backend))
+        ctl.bootstrap()
+        ctl.create_cell(t.Document(
+            kind=t.KIND_CELL,
+            metadata=t.Metadata(name="c1", realm=consts.DEFAULT_REALM,
+                                space=consts.DEFAULT_SPACE,
+                                stack=consts.DEFAULT_STACK),
+            spec=t.CellSpec(containers=[t.ContainerSpec(name="main", image="wd:v1")]),
+        ))
+        ctx = backend.started[-1]
+        # Runner passes the manifest workdir through; the PROCESS backend
+        # maps it into the rootfs at start. The fake backend records the
+        # pre-overlay context, so exercise the mapping helper directly.
+        from kukeon_tpu.runtime.cells.process import ProcessBackend
+
+        mapped = ProcessBackend._overlay_workdir(ctx)
+        rootfs = istore.rootfs("wd:v1")
+        assert mapped == os.path.join(rootfs, "srv-nonexistent-on-host")
+        assert os.path.isdir(mapped)
+
+
+class TestReviewRound4:
+    def test_config_values_image_kept_by_prune(self, tmp_path):
+        """A stored CellConfig overriding a blueprint image param keeps THAT
+        image alive through prune, not just the param default."""
+        rp = str(tmp_path / "rp")
+        istore = ImageStore(rp)
+        istore.put(ImageManifest(name="tool", tag="v1", entrypoint=["/bin/true"]))
+        istore.put(ImageManifest(name="tool", tag="v2", entrypoint=["/bin/true"]))
+        store = ResourceStore(MetadataStore(rp))
+        ctl = Controller(store, Runner(store, FakeBackend()))
+        ctl.bootstrap()
+        ctl.put_blueprint(t.Document(
+            kind=t.KIND_CELL_BLUEPRINT, metadata=t.Metadata(name="bp"),
+            spec=t.CellBlueprintSpec(
+                params=[t.BlueprintParam(name="img", default="tool:v1")],
+                cell=t.CellSpec(containers=[
+                    t.ContainerSpec(name="m", image="${img}"),
+                ]),
+            ),
+        ))
+        ctl.put_config(t.Document(
+            kind=t.KIND_CELL_CONFIG, metadata=t.Metadata(name="cfg"),
+            spec=t.CellConfigSpec(blueprint="bp", values={"img": "tool:v2"}),
+        ))
+        in_use = ctl.images_in_use()
+        assert {"tool:v1", "tool:v2"} <= in_use
+        assert istore.prune(in_use) == []
+
+    def test_rebuild_keeps_displaced_bundle_until_gc(self, store, tmp_path):
+        ctx = tmp_path / "ctx"
+        ctx.mkdir()
+        (ctx / "a.sh").write_text("echo a")
+        kf = tmp_path / "K"
+        kf.write_text("FROM scratch\nCOPY a.sh /bin/a.sh\n")
+        ImageBuilder(store).build(str(kf), str(ctx), "app:v1")
+        old_rootfs = store.rootfs("app:v1")
+        # A "running cell" holds a file open in the old rootfs.
+        held = os.path.join(old_rootfs, "bin/a.sh")
+        assert os.path.exists(held)
+        ImageBuilder(store).build(str(kf), str(ctx), "app:v1")
+        # Displaced bundle renamed, not deleted: the old tree still exists
+        # under a .old-* name until gc.
+        olds = [e for e in os.listdir(store.root) if ".old-" in e]
+        assert len(olds) == 1
+        assert os.path.exists(os.path.join(store.root, olds[0], "rootfs/bin/a.sh"))
+        assert store.gc_old() == 1
+        assert not [e for e in os.listdir(store.root) if ".old-" in e]
+
+    def test_bare_env_is_build_error(self, store, tmp_path):
+        ctx = tmp_path / "ctx"
+        ctx.mkdir()
+        kf = tmp_path / "K"
+        kf.write_text("FROM scratch\nENV\n")
+        with pytest.raises(InvalidArgument, match="ENV wants"):
+            ImageBuilder(store).build(str(kf), str(ctx), "x:v1")
+
+    def test_image_workdir_ignores_existing_host_dir(self, tmp_path):
+        """WORKDIR /tmp (exists on every host) must STILL resolve into the
+        rootfs for an image-backed container."""
+        from kukeon_tpu.runtime.cells.process import ProcessBackend
+
+        rp = str(tmp_path / "rp")
+        istore = ImageStore(rp)
+        istore.put(ImageManifest(name="wd", tag="v1", entrypoint=["pwd"],
+                                 workdir="/tmp"))
+        store = ResourceStore(MetadataStore(rp))
+        backend = FakeBackend()
+        ctl = Controller(store, Runner(store, backend))
+        ctl.bootstrap()
+        ctl.create_cell(t.Document(
+            kind=t.KIND_CELL,
+            metadata=t.Metadata(name="c1", realm=consts.DEFAULT_REALM,
+                                space=consts.DEFAULT_SPACE,
+                                stack=consts.DEFAULT_STACK),
+            spec=t.CellSpec(containers=[t.ContainerSpec(name="main", image="wd:v1")]),
+        ))
+        mapped = ProcessBackend._overlay_workdir(backend.started[-1])
+        assert mapped == os.path.join(istore.rootfs("wd:v1"), "tmp")

@@ -344,3 +344,113 @@ spec: {containers: [{name: m, command: [/bin/true]}]}
     results = c.delete_documents(blob)
     assert [r.action for r in results] == ["deleted", "deleted"]
     assert "temp" not in c.list_spaces("default")
+
+
+def _put_bp_cfg(c, *, cmd_default="/bin/true", cfg_name="cfg1"):
+    c.put_blueprint(t.Document(
+        kind=t.KIND_CELL_BLUEPRINT, metadata=t.Metadata(name="bp"),
+        spec=t.CellBlueprintSpec(
+            params=[t.BlueprintParam(name="cmd", default=cmd_default)],
+            cell=t.CellSpec(containers=[t.ContainerSpec(name="m", command=["${cmd}"])]),
+        ),
+    ))
+    c.put_config(t.Document(
+        kind=t.KIND_CELL_CONFIG, metadata=t.Metadata(name=cfg_name),
+        spec=t.CellConfigSpec(blueprint="bp", cell_name="sync-cell"),
+    ))
+
+
+def test_out_of_sync_synced_and_drift(ctl):
+    c, _, store, _ = ctl
+    _put_bp_cfg(c)
+    c.materialize_config("default", None, None, "cfg1")
+
+    # Fresh materialization: synced.
+    counts = c.reconcile_cells()
+    assert counts.get("out_of_sync", 0) == 0
+    rec = store.read_cell("default", "default", "default", "sync-cell")
+    assert rec.status.out_of_sync is False
+    assert rec.status.out_of_sync_reason is None
+
+    # Operator edits the config (new command) without re-applying: drift.
+    c.put_config(t.Document(
+        kind=t.KIND_CELL_CONFIG, metadata=t.Metadata(name="cfg1"),
+        spec=t.CellConfigSpec(blueprint="bp", cell_name="sync-cell",
+                              values={"cmd": "/bin/false"}),
+    ))
+    counts = c.reconcile_cells()
+    assert counts["out_of_sync"] == 1
+    rec = store.read_cell("default", "default", "default", "sync-cell")
+    assert rec.status.out_of_sync is True
+    assert "spec differs" in rec.status.out_of_sync_reason
+
+    # Re-materializing converges back to synced.
+    c.materialize_config("default", None, None, "cfg1")
+    c.reconcile_cells()
+    rec = store.read_cell("default", "default", "default", "sync-cell")
+    assert rec.status.out_of_sync is False
+
+
+def test_out_of_sync_config_deleted(ctl):
+    c, _, store, _ = ctl
+    _put_bp_cfg(c)
+    c.materialize_config("default", None, None, "cfg1")
+    c.delete_config("default", None, None, "cfg1")
+    counts = c.reconcile_cells()
+    assert counts["out_of_sync"] == 1
+    rec = store.read_cell("default", "default", "default", "sync-cell")
+    assert rec.status.out_of_sync is True
+    assert rec.status.out_of_sync_reason == "lineage Config deleted"
+
+
+def test_out_of_sync_blueprint_missing_is_error_not_drift(ctl):
+    c, _, store, _ = ctl
+    _put_bp_cfg(c)
+    c.materialize_config("default", None, None, "cfg1")
+    c.delete_blueprint("default", None, None, "bp")
+    counts = c.reconcile_cells()
+    # Undecidable: OutOfSyncError set, out_of_sync stays False.
+    assert counts.get("out_of_sync", 0) == 0
+    rec = store.read_cell("default", "default", "default", "sync-cell")
+    assert rec.status.out_of_sync is False
+    assert rec.status.out_of_sync_error
+    assert "bp" in rec.status.out_of_sync_error
+
+
+def test_out_of_sync_skips_hand_built_cells(ctl):
+    c, _, store, _ = ctl
+    c.create_cell(_cell_doc())
+    c.reconcile_cells()
+    rec = store.read_cell("default", "default", "default", "c1")
+    assert rec.status.out_of_sync is False
+    assert rec.status.out_of_sync_reason is None
+    assert rec.status.out_of_sync_error is None
+
+
+def test_out_of_sync_does_not_resurrect_auto_deleted_cell(ctl):
+    """Review regression: an auto-delete cell with drifted config must stay
+    deleted — the out-of-sync pass must not write the record back."""
+    c, backend, store, _ = ctl
+    c.put_blueprint(t.Document(
+        kind=t.KIND_CELL_BLUEPRINT, metadata=t.Metadata(name="bp2"),
+        spec=t.CellBlueprintSpec(
+            cell=t.CellSpec(
+                auto_delete=True,
+                containers=[t.ContainerSpec(name="m", command=["/bin/true"])],
+            ),
+        ),
+    ))
+    c.put_config(t.Document(
+        kind=t.KIND_CELL_CONFIG, metadata=t.Metadata(name="cfg2"),
+        spec=t.CellConfigSpec(blueprint="bp2", cell_name="ghost"),
+    ))
+    c.materialize_config("default", None, None, "cfg2")
+    # Drift the lineage, then let the workload exit -> auto delete.
+    c.delete_config("default", None, None, "cfg2")
+    backend.exit(store.container_dir("default", "default", "default", "ghost", "m"), 0)
+    counts = c.reconcile_cells()
+    assert counts.get("auto-deleted") == 1
+    assert not store.cell_exists("default", "default", "default", "ghost")
+    # And it stays gone on the next tick.
+    c.reconcile_cells()
+    assert not store.cell_exists("default", "default", "default", "ghost")
