@@ -1,0 +1,207 @@
+"""BERT-family encoder (bge-base embedding model), functional JAX.
+
+BASELINE.json config 5 pairs a Llama chat cell with a "bge-base embedding
+cell (2 chips)"; this is that embedding model. bge-base IS BERT-base with
+CLS pooling + L2 normalization, so the module implements the BERT encoder
+the TPU-first way (same design stance as models/llama.py):
+
+- **Pure functional**: params are a plain pytree; forward is jittable and
+  shardable with the same ``parallel.sharding`` rules as the decoder.
+- **Stacked layers + ``lax.scan``**: one stacked weight set, O(1) compile
+  in depth.
+- **bf16 matmuls, f32 norms/softmax**: MXU-friendly without numeric drift.
+- **Bidirectional attention with a padding mask** — no causal mask, no KV
+  cache (encoders embed whole sequences in one pass; serving batches them).
+
+The reference runtime (eminwux/kukeon) has no model math; this file exists
+for the TPU build's multi-model Session story (SURVEY.md §7 step 6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def param_count(self) -> int:
+        H, I, L = self.hidden_size, self.intermediate_size, self.num_layers
+        embed = (self.vocab_size + self.max_position_embeddings
+                 + self.type_vocab_size) * H + 2 * H
+        attn = 4 * (H * H + H)
+        mlp = H * I + I + I * H + H
+        norms = 4 * H
+        return embed + L * (attn + mlp + norms)
+
+
+def bge_base() -> BertConfig:
+    """BAAI/bge-base-en shapes (= BERT-base)."""
+    return BertConfig()
+
+
+def bge_tiny() -> BertConfig:
+    """Test-size config: fast on a CPU mesh."""
+    return BertConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, max_position_embeddings=128,
+        dtype=jnp.float32,
+    )
+
+
+# --- Init --------------------------------------------------------------------
+
+def init_params(key: jax.Array, cfg: BertConfig) -> Params:
+    """Random-init parameter pytree (stacked layers on axis 0).
+
+    Layout:
+      embed:      word [V, H], position [P, H], type [T, H],
+                  norm_scale/bias [H]
+      layers:     wq/wk/wv/wo [L, H, H] (+ biases [L, H]),
+                  attn_norm_scale/bias [L, H],
+                  w_in [L, H, I] + b_in [L, I], w_out [L, I, H] + b_out [L, H],
+                  mlp_norm_scale/bias [L, H]
+    """
+    c = cfg
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(k, shape, fan_in):
+        scale = fan_in ** -0.5
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(c.dtype)
+
+    L, H, I = c.num_layers, c.hidden_size, c.intermediate_size
+    return {
+        "embed": {
+            "word": dense(next(keys), (c.vocab_size, H), H),
+            "position": dense(next(keys), (c.max_position_embeddings, H), H),
+            "type": dense(next(keys), (c.type_vocab_size, H), H),
+            "norm_scale": jnp.ones((H,), c.dtype),
+            "norm_bias": jnp.zeros((H,), c.dtype),
+        },
+        "layers": {
+            "wq": dense(next(keys), (L, H, H), H),
+            "bq": jnp.zeros((L, H), c.dtype),
+            "wk": dense(next(keys), (L, H, H), H),
+            "bk": jnp.zeros((L, H), c.dtype),
+            "wv": dense(next(keys), (L, H, H), H),
+            "bv": jnp.zeros((L, H), c.dtype),
+            "wo": dense(next(keys), (L, H, H), H),
+            "bo": jnp.zeros((L, H), c.dtype),
+            "attn_norm_scale": jnp.ones((L, H), c.dtype),
+            "attn_norm_bias": jnp.zeros((L, H), c.dtype),
+            "w_in": dense(next(keys), (L, H, I), H),
+            "b_in": jnp.zeros((L, I), c.dtype),
+            "w_out": dense(next(keys), (L, I, H), I),
+            "b_out": jnp.zeros((L, H), c.dtype),
+            "mlp_norm_scale": jnp.ones((L, H), c.dtype),
+            "mlp_norm_bias": jnp.zeros((L, H), c.dtype),
+        },
+    }
+
+
+# --- Forward -----------------------------------------------------------------
+
+def _layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
+                eps: float) -> jnp.ndarray:
+    """Full LayerNorm (mean + variance) in f32 — BERT is post-LN and
+    mean-sensitive, unlike the decoder's RMSNorm."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    y = (x - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
+def forward(
+    params: Params,
+    cfg: BertConfig,
+    tokens: jnp.ndarray,
+    mask: jnp.ndarray,
+    token_types: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Encode. tokens/mask: [B, S] (mask 1 = real token, 0 = pad).
+    Returns the final hidden states [B, S, H] in f32."""
+    c = cfg
+    B, S = tokens.shape
+    pos = jnp.arange(S, dtype=jnp.int32)[None, :]
+    tt = token_types if token_types is not None else jnp.zeros_like(tokens)
+
+    e = params["embed"]
+    x = (
+        jnp.take(e["word"], tokens, axis=0)
+        + jnp.take(e["position"], pos, axis=0)
+        + jnp.take(e["type"], tt, axis=0)
+    ).astype(c.dtype)
+    x = _layer_norm(x, e["norm_scale"], e["norm_bias"], c.layer_norm_eps)
+
+    # Additive attention bias: padded keys get -inf for every query.
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+    attn_bias = jnp.where(mask[:, None, None, :].astype(bool), 0.0, neg)  # [B,1,1,S]
+    scale = c.head_dim ** -0.5
+
+    def layer_step(x, w):
+        def proj(name, bname):
+            return (x @ w[name] + w[bname]).reshape(B, S, c.num_heads, c.head_dim)
+
+        q = proj("wq", "bq")
+        k = proj("wk", "bk")
+        v = proj("wv", "bv")
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        probs = jax.nn.softmax(logits + attn_bias, axis=-1).astype(c.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, c.hidden_size)
+        attn = attn @ w["wo"] + w["bo"]
+        x = _layer_norm(x + attn, w["attn_norm_scale"], w["attn_norm_bias"],
+                        c.layer_norm_eps)
+
+        h = jax.nn.gelu((x @ w["w_in"] + w["b_in"]).astype(jnp.float32),
+                        approximate=False).astype(c.dtype)
+        h = h @ w["w_out"] + w["b_out"]
+        x = _layer_norm(x + h, w["mlp_norm_scale"], w["mlp_norm_bias"],
+                        c.layer_norm_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_step, x, params["layers"])
+    return x.astype(jnp.float32)
+
+
+def embed(
+    params: Params,
+    cfg: BertConfig,
+    tokens: jnp.ndarray,
+    mask: jnp.ndarray,
+    pooling: str = "cls",
+) -> jnp.ndarray:
+    """Sentence embeddings, bge-style: encode, pool, L2-normalize.
+    Returns [B, H] f32 unit vectors. ``pooling``: "cls" (bge default) or
+    "mean" (mask-weighted)."""
+    hidden = forward(params, cfg, tokens, mask)
+    if pooling == "cls":
+        pooled = hidden[:, 0, :]
+    elif pooling == "mean":
+        m = mask.astype(jnp.float32)[:, :, None]
+        pooled = (hidden * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    else:
+        raise ValueError(f"unknown pooling {pooling!r}")
+    return pooled / jnp.maximum(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12
+    )

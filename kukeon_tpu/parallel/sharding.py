@@ -69,6 +69,43 @@ def shard_params(params, mesh: Mesh, fsdp: bool = False):
     return jax.device_put(params, shardings)
 
 
+def bert_param_specs(fsdp: bool = False) -> dict:
+    """PartitionSpec pytree matching models.bert.init_params — the same
+    megatron column->row pairing as the decoder: qkv/in projections shard
+    their output dim on 'tensor', wo/out their input dim, one psum per
+    block. Biases follow their matmul's output sharding."""
+    f = AXIS_FSDP if fsdp else None
+    t = AXIS_TENSOR
+    return {
+        "embed": {
+            "word": P(t, f),                    # vocab-sharded
+            "position": P(None, f),
+            "type": P(None, f),
+            "norm_scale": P(None),
+            "norm_bias": P(None),
+        },
+        "layers": {
+            "wq": P(None, f, t), "bq": P(None, t),
+            "wk": P(None, f, t), "bk": P(None, t),
+            "wv": P(None, f, t), "bv": P(None, t),
+            "wo": P(None, t, f), "bo": P(None, None),
+            "attn_norm_scale": P(None, None), "attn_norm_bias": P(None, None),
+            "w_in": P(None, f, t), "b_in": P(None, t),
+            "w_out": P(None, t, f), "b_out": P(None, None),
+            "mlp_norm_scale": P(None, None), "mlp_norm_bias": P(None, None),
+        },
+    }
+
+
+def shard_bert_params(params, mesh: Mesh, fsdp: bool = False):
+    specs = bert_param_specs(fsdp)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.device_put(params, shardings)
+
+
 def batch_spec() -> P:
     """Tokens/positions: batch over (data, fsdp), sequence over seq axis."""
     return P((AXIS_DATA, AXIS_FSDP), AXIS_SEQ)

@@ -27,15 +27,20 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 MODELS = {}
+EMBEDDING_MODELS = {}
 
 
 def _register_models():
-    from kukeon_tpu.models import llama
+    from kukeon_tpu.models import bert, llama
 
     MODELS.update({
         "tiny": llama.llama_tiny,
         "llama3-1b": llama.llama3_1b,
         "llama3-8b": llama.llama3_8b,
+    })
+    EMBEDDING_MODELS.update({
+        "bge-base": bert.bge_base,
+        "bge-tiny": bert.bge_tiny,
     })
 
 
@@ -64,7 +69,10 @@ class ServingCell:
 
         _register_models()
         if model not in MODELS:
-            raise SystemExit(f"unknown model {model!r}; known: {sorted(MODELS)}")
+            raise SystemExit(
+                f"unknown model {model!r}; known: "
+                f"{sorted(MODELS) + sorted(EMBEDDING_MODELS)}"
+            )
         cfg = MODELS[model]()
         if dtype:
             import jax.numpy as jnp
@@ -147,6 +155,96 @@ class ServingCell:
         }
 
 
+class EmbeddingCell:
+    """Embedding-model serving cell (bge-base): /v1/embed instead of
+    /v1/generate; same health/stats seams as the decoder cell so the
+    reconciler treats both cell flavors identically."""
+
+    def __init__(self, model: str, *, batch_size: int = 16,
+                 pooling: str = "cls", checkpoint: str | None = None,
+                 dtype: str | None = None, seed: int = 0):
+        import dataclasses
+
+        import jax
+
+        from kukeon_tpu.models import bert
+        from kukeon_tpu.parallel import auto_mesh_shape, make_mesh
+        from kukeon_tpu.serving import EmbeddingEngine
+
+        _register_models()
+        cfg = EMBEDDING_MODELS[model]()
+        if dtype:
+            import jax.numpy as jnp
+
+            cfg = dataclasses.replace(cfg, dtype=getattr(jnp, dtype))
+        n = len(jax.devices())
+        shape = auto_mesh_shape(n)
+        mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
+        if checkpoint:
+            params = self._load_checkpoint(checkpoint, cfg)
+        else:
+            params = bert.init_params(jax.random.key(seed), cfg)
+
+        self.model_name = model
+        self.cfg = cfg
+        self.engine = EmbeddingEngine(cfg, params, mesh,
+                                      batch_size=batch_size, pooling=pooling)
+        self.tokenizer = ByteTokenizer()
+        self.started_at = time.time()
+        self.total_sequences = 0
+        self._stats_lock = threading.Lock()
+
+    @staticmethod
+    def _load_checkpoint(path: str, cfg):
+        import jax
+        import orbax.checkpoint as ocp
+
+        from kukeon_tpu.models import bert
+
+        abstract = jax.eval_shape(
+            lambda k: bert.init_params(k, cfg), jax.random.key(0)
+        )
+        return ocp.StandardCheckpointer().restore(path, abstract)
+
+    def warmup(self, prompt_len: int = 64):
+        self.engine.warmup((prompt_len,))
+
+    def embed(self, req: dict) -> dict:
+        if "inputTokens" in req:
+            prompts = [np.asarray(p, np.int32) for p in req["inputTokens"]]
+        elif "inputs" in req:
+            texts = req["inputs"]
+            if isinstance(texts, str):
+                texts = [texts]
+            prompts = [np.asarray(self.tokenizer.encode(x) or [1], np.int32)
+                       for x in texts]
+        else:
+            raise ValueError("need inputs or inputTokens")
+        t0 = time.monotonic()
+        vecs = self.engine.embed_batch(prompts)
+        dt = time.monotonic() - t0
+        with self._stats_lock:
+            self.total_sequences += len(prompts)
+        return {
+            "embeddings": [v.tolist() for v in vecs],
+            "dim": int(vecs.shape[1]) if len(prompts) else self.cfg.hidden_size,
+            "numSequences": len(prompts),
+            "seconds": round(dt, 4),
+        }
+
+    def stats(self) -> dict:
+        import jax
+
+        return {
+            "model": self.model_name,
+            "kind": "embedding",
+            "devices": [str(d) for d in jax.devices()],
+            "batchSize": self.engine.batch_size,
+            "uptimeSeconds": round(time.time() - self.started_at, 1),
+            "totalSequences": self.total_sequences,
+        }
+
+
 def make_handler(cell: ServingCell):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *a):
@@ -169,13 +267,20 @@ def make_handler(cell: ServingCell):
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
-            if self.path != "/v1/generate":
-                self._send(404, {"error": f"no route {self.path}"})
+            routes = {}
+            if hasattr(cell, "generate"):
+                routes["/v1/generate"] = cell.generate
+            if hasattr(cell, "embed"):
+                routes["/v1/embed"] = cell.embed
+            fn = routes.get(self.path)
+            if fn is None:
+                self._send(404, {"error": f"no route {self.path}; "
+                                          f"this cell serves {sorted(routes)}"})
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
-                self._send(200, cell.generate(req))
+                self._send(200, fn(req))
             except ValueError as e:
                 self._send(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — server must keep serving
@@ -196,14 +301,21 @@ def main(argv=None) -> int:
     ap.add_argument("--no-warmup", action="store_true")
     args = ap.parse_args(argv)
 
-    cell = ServingCell(
-        args.model, num_slots=args.num_slots, max_seq_len=args.max_seq_len,
-        checkpoint=args.checkpoint, dtype=args.dtype,
-    )
-    # Warmup before the engine thread starts: step() is single-driver.
-    if not args.no_warmup:
-        cell.warmup()
-    cell.engine.start()
+    _register_models()
+    if args.model in EMBEDDING_MODELS:
+        cell = EmbeddingCell(args.model, batch_size=args.num_slots,
+                             checkpoint=args.checkpoint, dtype=args.dtype)
+        if not args.no_warmup:
+            cell.warmup()
+    else:
+        cell = ServingCell(
+            args.model, num_slots=args.num_slots, max_seq_len=args.max_seq_len,
+            checkpoint=args.checkpoint, dtype=args.dtype,
+        )
+        # Warmup before the engine thread starts: step() is single-driver.
+        if not args.no_warmup:
+            cell.warmup()
+        cell.engine.start()
     server = ThreadingHTTPServer((args.host, args.port), make_handler(cell))
     print(f"serving-cell: {args.model} ready on {args.host}:{args.port}", flush=True)
     try:

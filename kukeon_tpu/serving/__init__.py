@@ -9,3 +9,7 @@ from kukeon_tpu.serving.sampling import (  # noqa: F401
     sample,
     sample_per_slot,
 )
+from kukeon_tpu.serving.embedding import (  # noqa: F401
+    EMBED_BUCKETS,
+    EmbeddingEngine,
+)

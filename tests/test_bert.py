@@ -1,0 +1,118 @@
+"""bge-base embedding model: forward shapes, mask correctness, pooling,
+sharded embed over the tensor axis, and the EmbeddingEngine's bucketing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kukeon_tpu.models import bert
+from kukeon_tpu.parallel import make_mesh
+from kukeon_tpu.serving import EmbeddingEngine
+from kukeon_tpu.serving.embedding import bucket_length
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = bert.bge_tiny()
+    params = bert.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+class TestModel:
+    def test_forward_shapes(self, setup):
+        cfg, params = setup
+        B, S = 3, 17
+        tokens = jax.random.randint(jax.random.key(1), (B, S), 0, cfg.vocab_size)
+        mask = jnp.ones((B, S), jnp.int32)
+        hidden = bert.forward(params, cfg, tokens, mask)
+        assert hidden.shape == (B, S, cfg.hidden_size)
+        assert hidden.dtype == jnp.float32
+
+    def test_embed_unit_norm(self, setup):
+        cfg, params = setup
+        tokens = jax.random.randint(jax.random.key(2), (2, 9), 0, cfg.vocab_size)
+        mask = jnp.ones((2, 9), jnp.int32)
+        for pooling in ("cls", "mean"):
+            v = bert.embed(params, cfg, tokens, mask, pooling=pooling)
+            assert v.shape == (2, cfg.hidden_size)
+            np.testing.assert_allclose(
+                np.linalg.norm(np.asarray(v), axis=-1), 1.0, rtol=1e-5
+            )
+
+    def test_padding_invariance(self, setup):
+        """The same sequence must embed identically regardless of how much
+        padding follows it — the padding mask has to be airtight."""
+        cfg, params = setup
+        seq = jax.random.randint(jax.random.key(3), (1, 8), 1, cfg.vocab_size)
+
+        short_tokens = seq
+        short_mask = jnp.ones((1, 8), jnp.int32)
+        v_short = bert.embed(params, cfg, short_tokens, short_mask)
+
+        long_tokens = jnp.concatenate(
+            [seq, jnp.zeros((1, 24), jnp.int32)], axis=1
+        )
+        long_mask = jnp.concatenate(
+            [short_mask, jnp.zeros((1, 24), jnp.int32)], axis=1
+        )
+        v_long = bert.embed(params, cfg, long_tokens, long_mask)
+        np.testing.assert_allclose(
+            np.asarray(v_short), np.asarray(v_long), atol=2e-5
+        )
+
+    def test_bidirectional_not_causal(self, setup):
+        """Changing a LATER token must change an EARLIER position's hidden
+        state (encoders attend both ways; a causal bug would freeze it)."""
+        cfg, params = setup
+        base = jax.random.randint(jax.random.key(4), (1, 8), 1, cfg.vocab_size)
+        mask = jnp.ones((1, 8), jnp.int32)
+        h1 = bert.forward(params, cfg, base, mask)
+        changed = base.at[0, 7].set((base[0, 7] + 1) % cfg.vocab_size)
+        h2 = bert.forward(params, cfg, changed, mask)
+        assert not np.allclose(np.asarray(h1[0, 0]), np.asarray(h2[0, 0]))
+
+    def test_param_count_matches_tree(self, setup):
+        cfg, params = setup
+        total = sum(x.size for x in jax.tree.leaves(params))
+        assert total == cfg.param_count()
+
+
+class TestEngine:
+    def test_bucket_length(self):
+        assert bucket_length(5, 512) == 16
+        assert bucket_length(16, 512) == 16
+        assert bucket_length(17, 512) == 32
+        assert bucket_length(600, 512) == 512
+        assert bucket_length(100, 64) == 64   # clamped to model max
+
+    def test_embed_batch_matches_direct(self, setup):
+        cfg, params = setup
+        mesh = make_mesh(tensor=2, data=4)
+        engine = EmbeddingEngine(cfg, params, mesh, batch_size=4)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, cfg.vocab_size, size=n).astype(np.int32)
+                   for n in (5, 30, 12, 3, 21)]   # 5 prompts > batch 4
+        vecs = engine.embed_batch(prompts)
+        assert vecs.shape == (5, cfg.hidden_size)
+        # Each row matches the unsharded single-sequence embedding.
+        for i, p in enumerate(prompts):
+            direct = bert.embed(
+                params, cfg, jnp.asarray(p)[None, :],
+                jnp.ones((1, p.size), jnp.int32),
+            )
+            np.testing.assert_allclose(vecs[i], np.asarray(direct[0]), atol=3e-5)
+
+    def test_oversized_sequence_rejected(self, setup):
+        cfg, params = setup
+        mesh = make_mesh(tensor=1, data=8)
+        engine = EmbeddingEngine(cfg, params, mesh, batch_size=2)
+        too_long = np.ones((cfg.max_position_embeddings + 1,), np.int32)
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            engine.embed_batch([too_long])
+
+    def test_empty_batch(self, setup):
+        cfg, params = setup
+        mesh = make_mesh(tensor=1, data=8)
+        engine = EmbeddingEngine(cfg, params, mesh, batch_size=2)
+        assert engine.embed_batch([]).shape == (0, cfg.hidden_size)
