@@ -1,12 +1,14 @@
 """kuke: the CLI (reference: cmd/kuke, 23 verbs).
 
-Verbs: init, daemon (serve/start/stop/status/logs), apply, delete, create,
-get, run, start, stop, kill, attach, log, purge, refresh, status, doctor,
-image (stub for the process backend), team, uninstall, version, autocomplete.
+Verbs: init, daemon (serve/start/stop/kill/restart/status/logs), apply,
+create, delete, get, run, start, stop, kill, attach, log, purge, refresh,
+status, doctor, image, build, team, uninstall, version, autocomplete.
 
 Workload verbs route to the daemon; read/maintenance verbs "promote" to an
 in-process controller when --no-daemon / KUKEON_NO_DAEMON is set (reference
-process model: docs/site/architecture/process-model.md).
+process model: docs/site/architecture/process-model.md). Every knob resolves
+flag > env > configuration document > default through the config registry
+(kukeon_tpu/runtime/config.py).
 """
 
 from __future__ import annotations
@@ -27,14 +29,39 @@ from kukeon_tpu.runtime.client import LocalClient, UnixClient
 from kukeon_tpu.runtime.errors import KukeonError
 
 
+def _parse_kv_args(pairs, flag: str) -> dict[str, str]:
+    """KEY=VALUE arg list -> dict, with a usage error (not a traceback) on a
+    malformed pair."""
+    out = {}
+    for kv in pairs or []:
+        k, sep, v = kv.partition("=")
+        if not sep or not k:
+            raise KukeonError(f"{flag} wants KEY=VALUE, got {kv!r}")
+        out[k] = v
+    return out
+
+
+def _client_settings():
+    """Client-side knob resolution: flag > env > ClientConfiguration doc >
+    default (reference: cmd/config precedence; internal/clientconfig)."""
+    from kukeon_tpu.runtime import config
+
+    try:
+        return config.client_settings()
+    except KukeonError as e:
+        print(f"warning: {e}", file=sys.stderr)
+        return config.Settings()
+
+
 def _run_path(args) -> str:
-    return args.run_path or consts.env_run_path()
+    return _client_settings().get("KUKEON_RUN_PATH", args.run_path)
 
 
 def _client(args):
-    if getattr(args, "no_daemon", False) or os.environ.get("KUKEON_NO_DAEMON") == "true":
+    s = _client_settings()
+    if getattr(args, "no_daemon", False) or s.get("KUKEON_NO_DAEMON"):
         return LocalClient(_run_path(args))
-    sock = args.socket or os.environ.get("KUKEOND_SOCKET") or consts.socket_path(_run_path(args))
+    sock = s.get("KUKEOND_SOCKET", args.socket) or consts.socket_path(_run_path(args))
     return UnixClient(sock)
 
 
@@ -107,10 +134,7 @@ def cmd_build(args):
 
     context = os.path.abspath(args.context)
     kukefile = args.file or os.path.join(context, "Kukefile")
-    build_args = {}
-    for kv in args.build_arg or []:
-        k, _, v = kv.partition("=")
-        build_args[k] = v
+    build_args = _parse_kv_args(args.build_arg, "--build-arg")
     builder = ImageBuilder(ImageStore(_run_path(args)))
     m = builder.build(kukefile, context_dir=context, tag=args.tag,
                       build_args=build_args)
@@ -220,9 +244,9 @@ def cmd_daemon(args):
     if args.daemon_cmd == "serve":
         from kukeon_tpu.runtime.daemon import DaemonServer
 
-        interval = float(os.environ.get("KUKEOND_RECONCILE_INTERVAL",
-                                        consts.DEFAULT_RECONCILE_INTERVAL_S))
-        DaemonServer(run_path, sock, reconcile_interval_s=interval).serve()
+        # Socket + interval resolution (flag > env > ServerConfiguration
+        # doc > default) happens inside DaemonServer via the config registry.
+        DaemonServer(run_path, args.socket).serve()
         return 0
     if args.daemon_cmd == "start":
         return _daemon_start(run_path, args.socket)
@@ -306,6 +330,66 @@ def cmd_delete(args):
         print(f"unknown kind {kind!r}", file=sys.stderr)
         return 2
     print(f"{kind}/{name}: deleted")
+    return 0
+
+
+def cmd_create(args):
+    """Imperative create (reference: cmd/kuke/create — realm, space, stack,
+    cell, secret, volume by name or any kind via -f)."""
+    c = _client(args)
+    s = _scope(args)
+    if args.file:
+        blob = sys.stdin.read() if args.file == "-" else open(args.file).read()
+        results = c.call("ApplyDocuments", yaml=blob)
+        for r in results:
+            print(f"{r['kind'].lower()}/{r['name']} ({r['scope']}): {r['action']}")
+        return 0
+    kind, name = args.kind, args.name
+    if not kind or not name:
+        print("error: kuke create wants -f FILE or KIND NAME", file=sys.stderr)
+        return 2
+    if kind in ("realm", "realms"):
+        c.call("CreateRealm", name=name)
+    elif kind in ("space", "spaces"):
+        c.call("CreateSpace", realm=s["realm"], name=name)
+    elif kind in ("stack", "stacks"):
+        c.call("CreateStack", realm=s["realm"], space=s["space"], name=name)
+    elif kind in ("cell", "cells"):
+        main = {"name": "main"}
+        if args.image:
+            main["image"] = args.image
+        if args.command:
+            main["command"] = args.command
+        doc = {
+            "apiVersion": "kukeon.io/v1beta1", "kind": "Cell",
+            "metadata": {"name": name, **{k: v for k, v in s.items() if v}},
+            "spec": {"containers": [main]},
+        }
+        rec = c.call("CreateCell", doc=doc, start=not args.no_start)
+        print(f"cell/{name}: {rec['status']['phase']}")
+        return 0
+    elif kind in ("secret", "secrets"):
+        data = _parse_kv_args(args.data, "--data")
+        if not data:
+            print("error: kuke create secret wants --data KEY=VALUE", file=sys.stderr)
+            return 2
+        blob = yaml.safe_dump({
+            "apiVersion": "kukeon.io/v1beta1", "kind": "Secret",
+            "metadata": {"name": name, "realm": s["realm"]},
+            "spec": {"data": data},
+        })
+        c.call("ApplyDocuments", yaml=blob)
+    elif kind in ("volume", "volumes"):
+        blob = yaml.safe_dump({
+            "apiVersion": "kukeon.io/v1beta1", "kind": "Volume",
+            "metadata": {"name": name, "realm": s["realm"]},
+            "spec": {"reclaimPolicy": args.reclaim_policy},
+        })
+        c.call("ApplyDocuments", yaml=blob)
+    else:
+        print(f"unknown kind {kind!r}", file=sys.stderr)
+        return 2
+    print(f"{kind}/{name}: created")
     return 0
 
 
@@ -394,7 +478,7 @@ def cmd_run(args):
     name = args.name
 
     if args.from_blueprint:
-        values = dict(kv.split("=", 1) for kv in (args.param or []))
+        values = _parse_kv_args(args.param, "--param")
         rec = c.call("RunBlueprint", realm=s["realm"], space=s["space"], stack=s["stack"],
                      blueprint=args.from_blueprint, values=values)
         name = rec["name"]
@@ -549,6 +633,61 @@ def cmd_refresh(args):
     return 0
 
 
+_BASH_COMPLETION = """\
+# kuke bash completion — source this file (kuke autocomplete bash).
+_kuke_complete() {
+    local cur="${COMP_WORDS[COMP_CWORD]}" prev="${COMP_WORDS[COMP_CWORD-1]}"
+    local verbs="init apply create build daemon get delete doctor start status \
+stop team kill purge refresh run attach log autocomplete image uninstall version"
+    if [ "$COMP_CWORD" -eq 1 ]; then
+        COMPREPLY=($(compgen -W "$verbs" -- "$cur")); return
+    fi
+    case "$prev" in
+        start|stop|kill|attach|log|run)
+            COMPREPLY=($(compgen -W "$(kuke autocomplete cells 2>/dev/null)" -- "$cur"));;
+        get|delete|purge|create)
+            COMPREPLY=($(compgen -W "realm space stack cell secret blueprint \
+config volume" -- "$cur"));;
+    esac
+}
+complete -F _kuke_complete kuke
+"""
+
+
+def cmd_autocomplete(args):
+    """Shell completion: `bash` emits the completion script; resource kinds
+    emit live names for dynamic completion (reference: cmd/config
+    autocomplete.go — daemon-backed completions)."""
+    what = args.what
+    if what == "bash":
+        print(_BASH_COMPLETION, end="")
+        return 0
+    try:
+        c = _client(args)
+        realm = getattr(args, "realm", None) or consts.DEFAULT_REALM
+        if what == "realms":
+            names = c.call("ListRealms")
+        elif what == "spaces":
+            names = c.call("ListSpaces", realm=realm)
+        elif what == "stacks":
+            names = c.call("ListStacks", realm=realm,
+                           space=getattr(args, "space", None) or consts.DEFAULT_SPACE)
+        elif what == "cells":
+            names = [r["name"] for r in c.call("ListCells", realm=realm,
+                                               space=None, stack=None)]
+        elif what == "blueprints":
+            names = c.call("ListBlueprints", realm=realm, space=None, stack=None)
+        elif what == "configs":
+            names = c.call("ListConfigs", realm=realm, space=None, stack=None)
+        else:
+            return 2
+        for n in names:
+            print(n)
+        return 0
+    except KukeonError:
+        return 0   # completion must never error loudly
+
+
 def cmd_uninstall(args):
     run_path = _run_path(args)
     if not args.yes:
@@ -615,6 +754,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("name", nargs="?", default=None)
     _scope_args(sp)
 
+    sp = sub_add("create")
+    sp.add_argument("kind", nargs="?", default=None)
+    sp.add_argument("name", nargs="?", default=None)
+    sp.add_argument("-f", "--file", default=None)
+    sp.add_argument("--image", default=None, help="cell: image for the main container")
+    sp.add_argument("--command", nargs=argparse.REMAINDER, default=None,
+                    help="cell: command for the main container")
+    sp.add_argument("--no-start", action="store_true",
+                    help="cell: create without starting")
+    sp.add_argument("--data", action="append", help="secret: KEY=VALUE")
+    sp.add_argument("--reclaim-policy", default="delete",
+                    choices=["delete", "retain"], help="volume reclaim policy")
+    _scope_args(sp)
+
     for verb in ("start", "stop", "kill"):
         sp = sub_add(verb)
         sp.add_argument("name")
@@ -674,6 +827,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub_add("uninstall")
     sp.add_argument("--yes", action="store_true")
+
+    sp = sub_add("autocomplete")
+    sp.add_argument("what", choices=["bash", "realms", "spaces", "stacks",
+                                     "cells", "blueprints", "configs"])
+    _scope_args(sp)
     return p
 
 
@@ -689,6 +847,7 @@ HANDLERS = {
     "daemon": cmd_daemon,
     "apply": cmd_apply,
     "delete": cmd_delete,
+    "create": cmd_create,
     "get": cmd_get,
     "start": cmd_lifecycle,
     "stop": cmd_lifecycle,
@@ -704,6 +863,7 @@ HANDLERS = {
     "build": cmd_build,
     "team": cmd_team,
     "uninstall": cmd_uninstall,
+    "autocomplete": cmd_autocomplete,
 }
 
 

@@ -1,0 +1,199 @@
+"""Typed configuration registry + on-disk configuration documents.
+
+Reference seams: cmd/config/env.go:25-260 (the ``Var`` registry twinning
+every flag with a ``KUKE_*``/``KUKEON_*``/``KUKEOND_*`` env var),
+internal/serverconfig (ServerConfiguration auto-written once on first daemon
+start, commented so operators can edit without reading source), and
+internal/clientconfig (client-side document).
+
+Precedence, matching the reference exactly:
+
+    explicit --flag  >  env var  >  configuration document  >  default
+
+The server document lives at ``<run_path>/kukeond.yaml`` by default
+(overridable with ``KUKEOND_CONFIGURATION``); the reference writes
+``/etc/kukeon/kukeond.yaml``, but this build keeps every artifact under the
+run path so parallel instances and tests never collide on /etc. The client
+document lives at ``~/.kuke-tpu/config.yaml`` (``KUKEON_CLIENT_CONFIGURATION``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+import yaml
+
+from kukeon_tpu.runtime import consts
+from kukeon_tpu.runtime.errors import InvalidArgument
+
+KIND_SERVER = "ServerConfiguration"
+KIND_CLIENT = "ClientConfiguration"
+
+
+@dataclasses.dataclass(frozen=True)
+class Var:
+    """One configuration knob: env var name, document spec key, default."""
+
+    env: str
+    key: str                    # camelCase key in the document's spec
+    default: Any
+    help: str = ""
+    cast: str = "str"           # str | float | int | bool
+
+    def parse(self, raw: str) -> Any:
+        try:
+            if self.cast == "float":
+                return float(raw)
+            if self.cast == "int":
+                return int(raw)
+            if self.cast == "bool":
+                return raw.strip().lower() in ("1", "true", "yes", "on")
+            return raw
+        except ValueError as e:
+            raise InvalidArgument(f"{self.env}={raw!r}: {e}") from e
+
+
+# The registry. Every knob the daemon or CLI reads goes through here so the
+# precedence chain is uniform (reference: cmd/config/env.go DefineKV).
+REGISTRY: tuple[Var, ...] = (
+    Var("KUKEON_RUN_PATH", "runPath", consts.DEFAULT_RUN_PATH,
+        "metadata + state root for this instance"),
+    Var("KUKEOND_SOCKET", "socket", "",
+        "daemon unix socket; empty = <runPath>/kukeond.sock"),
+    Var("KUKEOND_SOCKET_GID", "socketGID", 0,
+        "group ID the daemon chowns its socket to (0 = root only)", "int"),
+    Var("KUKEON_NO_DAEMON", "noDaemon", False,
+        "run verbs against an in-process controller", "bool"),
+    Var("KUKEOND_RECONCILE_INTERVAL", "reconcileInterval",
+        consts.DEFAULT_RECONCILE_INTERVAL_S,
+        "seconds between reconcile ticks (0 disables the loop)", "float"),
+    Var("KUKEON_POD_SUBNET_CIDR", "podSubnetCIDR", consts.DEFAULT_SUBNET_POOL,
+        "parent CIDR the per-space subnet allocator subdivides"),
+    Var("KUKEOND_DISK_PRESSURE_WARN_PCT", "diskPressureWarnPct",
+        consts.DISK_PRESSURE_WARN_PCT,
+        "disk usage %% that logs a warning each reconcile tick", "float"),
+    Var("KUKEOND_DISK_PRESSURE_BLOCK_PCT", "diskPressureBlockPct",
+        consts.DISK_PRESSURE_BLOCK_PCT,
+        "disk usage %% above which new cell creation is refused", "float"),
+    Var("KUKEON_STOP_GRACE_SECONDS", "stopGraceSeconds",
+        consts.DEFAULT_STOP_GRACE_S,
+        "SIGTERM->SIGKILL escalation window for container stop", "float"),
+    Var("KUKEON_TPU_CHIPS", "tpuChips", "",
+        "comma-separated chip ids overriding /dev/accel* discovery"),
+    Var("KUKEOND_LOG_LEVEL", "logLevel", "info",
+        "daemon log level (debug|info|warn|error)"),
+    Var("KUKEON_DEFAULT_MEMORY_LIMIT_BYTES", "defaultMemoryLimitBytes", 0,
+        "fallback memory limit for containers without one (0 = none)", "int"),
+    Var("KUKEON_CGROUP_ROOT", "cgroupRoot", "/kukeon-tpu",
+        "cgroup-v2 subtree all cells live under"),
+    Var("KUKEOND_CONFIGURATION", "", "",
+        "path of the ServerConfiguration document (meta: not itself stored)"),
+    Var("KUKEON_CLIENT_CONFIGURATION", "", "",
+        "path of the ClientConfiguration document (meta)"),
+)
+
+_BY_ENV = {v.env: v for v in REGISTRY}
+
+
+class Settings:
+    """Resolves knob values through flag > env > document > default."""
+
+    def __init__(self, doc_spec: dict | None = None):
+        self.doc_spec = dict(doc_spec or {})
+
+    def get(self, env_name: str, flag_value: Any = None) -> Any:
+        var = _BY_ENV[env_name]
+        if flag_value is not None:
+            return flag_value
+        raw = os.environ.get(var.env)
+        if raw is not None and raw != "":
+            return var.parse(raw)
+        if var.key and var.key in self.doc_spec:
+            val = self.doc_spec[var.key]
+            # Document values arrive as YAML scalars; coerce strings.
+            return var.parse(str(val)) if isinstance(val, str) else val
+        return var.default
+
+
+# --- configuration documents -------------------------------------------------
+
+
+def server_config_path(run_path: str) -> str:
+    return os.environ.get("KUKEOND_CONFIGURATION") or os.path.join(
+        run_path, "kukeond.yaml"
+    )
+
+
+def client_config_path() -> str:
+    return os.environ.get("KUKEON_CLIENT_CONFIGURATION") or os.path.join(
+        os.path.expanduser("~"), ".kuke-tpu", "config.yaml"
+    )
+
+
+def load_configuration(path: str, kind: str) -> dict:
+    """Parsed ``spec`` of the document at path. An absent file returns {}
+    (callers fall back to env + defaults — reference: serverconfig.go:41-68);
+    a present-but-invalid file is an error, never silently ignored."""
+    try:
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+    except FileNotFoundError:
+        return {}
+    except (OSError, yaml.YAMLError) as e:
+        raise InvalidArgument(f"read configuration {path!r}: {e}") from e
+    if not isinstance(doc, dict):
+        raise InvalidArgument(f"configuration {path!r}: not a mapping")
+    got = doc.get("kind", "")
+    if got and got != kind:
+        raise InvalidArgument(
+            f"configuration {path!r} has kind {got!r}, want {kind!r}"
+        )
+    spec = doc.get("spec") or {}
+    if not isinstance(spec, dict):
+        raise InvalidArgument(f"configuration {path!r}: spec is not a mapping")
+    return spec
+
+
+def write_default_server_configuration(path: str, values: dict) -> bool:
+    """First-start auto-write (reference: serverconfig.go WriteDefault):
+    renders a fully commented document carrying the values the daemon
+    actually bound to, O_EXCL so concurrent daemon starts can't both write,
+    and never overwrites an existing file. Returns True only on create."""
+    lines = [
+        "# kukeond ServerConfiguration — auto-generated on first daemon start.",
+        "# Precedence: explicit --flag > KUKEON_*/KUKEOND_* env > this file > default.",
+        "# Existing files are never overwritten; delete this file to regenerate.",
+        "apiVersion: kukeon.io/v1beta1",
+        f"kind: {KIND_SERVER}",
+        "metadata:",
+        "  name: default",
+        "spec:",
+    ]
+    for var in REGISTRY:
+        if not var.key:
+            continue
+        val = values.get(var.key, var.default)
+        lines.append(f"  # {var.help}  [env {var.env}]")
+        lines.append(f"  # Default: {var.default!r}")
+        lines.append("  " + yaml.safe_dump({var.key: val}).strip())
+        lines.append("")
+    rendered = "\n".join(lines).rstrip() + "\n"
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
+    except FileExistsError:
+        return False
+    with os.fdopen(fd, "w") as f:
+        f.write(rendered)
+    return True
+
+
+def server_settings(run_path: str) -> Settings:
+    return Settings(load_configuration(server_config_path(run_path), KIND_SERVER))
+
+
+def client_settings() -> Settings:
+    return Settings(load_configuration(client_config_path(), KIND_CLIENT))

@@ -65,7 +65,3 @@ DISK_PRESSURE_BLOCK_PCT = 95.0
 
 def socket_path(run_path: str) -> str:
     return os.path.join(run_path, DEFAULT_SOCKET_NAME)
-
-
-def env_run_path() -> str:
-    return os.environ.get("KUKEON_RUN_PATH", DEFAULT_RUN_PATH)

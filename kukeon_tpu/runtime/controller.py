@@ -570,41 +570,13 @@ class Controller:
                 scopes.append((space, None))
                 for stack in self.store.list_stacks(realm, space):
                     scopes.append((space, stack))
-            def blueprint_refs(doc: dict, values: dict[str, str]) -> set[str]:
-                """Image refs a blueprint doc would materialize under the
-                given param values (param defaults fill the gaps). A ref
-                still templated after substitution can't name a concrete
-                image and is skipped."""
-                params = {
-                    p.get("name"): p.get("default")
-                    for p in doc.get("spec", {}).get("params", []) or []
-                    if p.get("default") is not None
-                }
-                params.update(values)
-                refs: set[str] = set()
-                for c in (doc.get("spec", {}).get("cell", {}) or {}).get(
-                        "containers", []):
-                    ref = c.get("image")
-                    if not ref:
-                        continue
-                    if "${" in ref:
-                        ref = re.sub(
-                            r"\$\{([A-Za-z0-9_.-]+)\}",
-                            lambda m: str(params.get(m.group(1), m.group(0))),
-                            ref,
-                        )
-                        if "${" in ref:
-                            continue
-                    refs.add(ref)
-                return refs
-
             for space, stack in scopes:
                 for name in self.store.list_scoped(
                         consts.BLUEPRINTS_DIR, realm, space, stack):
                     doc = self.store.read_scoped(
                         consts.BLUEPRINTS_DIR, realm, space, stack, name)
                     if doc:
-                        out |= blueprint_refs(doc, {})
+                        out |= self._blueprint_image_refs(doc, {})
                 # Stored configs may override params (values: {img: ...});
                 # the images THEY would materialize must survive prune too.
                 for name in self.store.list_scoped(
@@ -618,8 +590,32 @@ class Controller:
                         consts.BLUEPRINTS_DIR, realm, space, stack,
                         spec.get("blueprint") or "")
                     if bp_doc:
-                        out |= blueprint_refs(bp_doc, dict(spec.get("values") or {}))
+                        out |= self._blueprint_image_refs(
+                            bp_doc, dict(spec.get("values") or {}))
         return out
+
+    @staticmethod
+    def _blueprint_image_refs(doc: dict, values: dict[str, str]) -> set[str]:
+        """Image refs a stored blueprint doc would materialize under the
+        given param values — computed with the SAME substitution path
+        materialization uses (substitute_scalar over blueprint_params). A
+        ref whose params stay unresolved can't name a concrete image and is
+        skipped."""
+        try:
+            bp = from_wire(t.CellBlueprintSpec, doc.get("spec") or {})
+            params = {p.name: p.default for p in bp.params}
+            params.update(values)
+        except (TypeError, KeyError, AttributeError):
+            return set()
+        refs: set[str] = set()
+        for c in bp.cell.containers:
+            if not c.image:
+                continue
+            try:
+                refs.add(substitute_scalar(c.image, params))
+            except InvalidArgument:
+                continue
+        return refs
 
     def reconcile_space_networks(self) -> dict[str, dict]:
         """Re-assert every space's bridge/conflist/egress chain (reference:
@@ -743,13 +739,27 @@ def diff_cell_spec(old: t.CellSpec, new: t.CellSpec) -> str:
     return COMPATIBLE
 
 
-def substitute_blueprint(bp: t.CellBlueprintSpec, values: dict[str, str]) -> t.CellSpec:
-    """``${param}`` scalar substitution over the blueprint's cell template
-    (reference: cellblueprint/params.go:47-174)."""
-    import copy
-    import re
+_PARAM_RE = re.compile(r"\$\{([A-Za-z0-9_.-]+)\}")
 
-    params = {p.name: p.default for p in bp.params}
+
+def substitute_scalar(s: str, params: dict[str, Any]) -> str:
+    """``${param}`` substitution over one scalar — the ONE implementation
+    shared by blueprint materialization and the prune keep-set, so the two
+    can never diverge on substitution semantics."""
+
+    def repl(m):
+        key = m.group(1)
+        if key not in params or params[key] is None:
+            raise InvalidArgument(f"blueprint param {key!r} has no value")
+        return str(params[key])
+
+    return _PARAM_RE.sub(repl, s)
+
+
+def blueprint_params(bp: t.CellBlueprintSpec, values: dict[str, str]) -> dict[str, Any]:
+    """Effective param map (defaults overlaid with caller values), with
+    required-param validation."""
+    params: dict[str, Any] = {p.name: p.default for p in bp.params}
     params.update(values)
     missing = [
         p.name for p in bp.params
@@ -757,16 +767,18 @@ def substitute_blueprint(bp: t.CellBlueprintSpec, values: dict[str, str]) -> t.C
     ]
     if missing:
         raise InvalidArgument(f"blueprint requires params: {missing}")
+    return params
 
-    pattern = re.compile(r"\$\{([A-Za-z0-9_.-]+)\}")
+
+def substitute_blueprint(bp: t.CellBlueprintSpec, values: dict[str, str]) -> t.CellSpec:
+    """``${param}`` scalar substitution over the blueprint's cell template
+    (reference: cellblueprint/params.go:47-174)."""
+    import copy
+
+    params = blueprint_params(bp, values)
 
     def sub_str(s: str) -> str:
-        def repl(m):
-            key = m.group(1)
-            if key not in params or params[key] is None:
-                raise InvalidArgument(f"blueprint param {key!r} has no value")
-            return str(params[key])
-        return pattern.sub(repl, s)
+        return substitute_scalar(s, params)
 
     def walk(obj: Any) -> Any:
         if isinstance(obj, str):

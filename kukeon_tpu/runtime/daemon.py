@@ -41,9 +41,13 @@ from kukeon_tpu.runtime.store import ResourceStore
 PROTOCOL_VERSION = "v1"
 
 
-def build_controller(run_path: str) -> Controller:
+def build_controller(run_path: str,
+                     settings: "config.Settings | None" = None) -> Controller:
+    from kukeon_tpu.runtime import config
     from kukeon_tpu.runtime.net import NetworkManager
+    from kukeon_tpu.runtime.runner import RunnerOptions
 
+    s = settings or config.server_settings(run_path)
     ms = MetadataStore(run_path)
     store = ResourceStore(ms)
     cg = CgroupManager()
@@ -52,7 +56,13 @@ def build_controller(run_path: str) -> Controller:
         ProcessBackend(),
         cgroups=cg if cg.available() else None,
         devices=TPUDeviceManager(ms),
-        netman=NetworkManager(store),
+        netman=NetworkManager(
+            store, subnet_pool=s.get("KUKEON_POD_SUBNET_CIDR")
+        ),
+        options=RunnerOptions(
+            stop_grace_s=s.get("KUKEON_STOP_GRACE_SECONDS"),
+            disk_pressure_block_pct=s.get("KUKEOND_DISK_PRESSURE_BLOCK_PCT"),
+        ),
     )
     return Controller(store, runner)
 
@@ -345,16 +355,40 @@ class _ThreadingUnixServer(socketserver.ThreadingUnixStreamServer):
 
 class DaemonServer:
     def __init__(self, run_path: str, socket_path: str | None = None,
-                 reconcile_interval_s: float = consts.DEFAULT_RECONCILE_INTERVAL_S):
+                 reconcile_interval_s: float | None = None):
+        from kukeon_tpu.runtime import config
+
         self.run_path = run_path
-        self.socket_path = socket_path or consts.socket_path(run_path)
-        self.reconcile_interval_s = reconcile_interval_s
-        self.ctl = build_controller(run_path)
+        self.settings = config.server_settings(run_path)
+        self.socket_path = (
+            socket_path
+            or self.settings.get("KUKEOND_SOCKET")
+            or consts.socket_path(run_path)
+        )
+        self.reconcile_interval_s = (
+            reconcile_interval_s
+            if reconcile_interval_s is not None
+            else self.settings.get("KUKEOND_RECONCILE_INTERVAL")
+        )
+        self.ctl = build_controller(run_path, self.settings)
         self._shutdown = threading.Event()
         self._server: _ThreadingUnixServer | None = None
 
     def serve(self) -> None:
+        from kukeon_tpu.runtime import config
+
         os.makedirs(self.run_path, exist_ok=True)
+        # First daemon start persists the resolved configuration as a
+        # commented document the operator can edit (reference:
+        # serverconfig.go WriteDefault, O_EXCL first-write-only).
+        config.write_default_server_configuration(
+            config.server_config_path(self.run_path),
+            {
+                "runPath": self.run_path,
+                "socket": self.socket_path,
+                "reconcileInterval": self.reconcile_interval_s,
+            },
+        )
         self.ctl.bootstrap()
         # Stale socket from a previous daemon: unlink after a probe.
         if os.path.exists(self.socket_path):
@@ -369,6 +403,14 @@ class DaemonServer:
         self._server = _ThreadingUnixServer(self.socket_path, _Handler)
         self._server.rpc_service = RPCService(self.ctl, self)  # type: ignore[attr-defined]
         os.chmod(self.socket_path, 0o660)
+        # Socket group access for non-root clients (reference: SocketGID,
+        # server.go:42-116 — chown root:kukeon so group members can dial).
+        gid = self.settings.get("KUKEOND_SOCKET_GID")
+        if gid:
+            try:
+                os.chown(self.socket_path, -1, int(gid))
+            except (OSError, PermissionError):
+                pass  # non-root daemon: group access simply stays off
 
         # Boot heal: reboots flush iptables/bridges; re-assert the FORWARD
         # admission chain + every space network before serving (reference:

@@ -128,6 +128,8 @@ class ImageStore:
             return []
         out = []
         for entry in sorted(os.listdir(self.root)):
+            if entry.startswith("."):   # .staging-* / .trash are not images
+                continue
             path = os.path.join(self.root, entry, "manifest.json")
             if os.path.exists(path):
                 with open(path) as f:
@@ -166,16 +168,22 @@ class ImageStore:
         bundle (stale rootfs included) is swapped out whole, never merged.
         Concurrent commits of the same ref serialize; last one wins.
 
-        The displaced bundle is RENAMED to ``<dir>.old-*`` and kept, not
+        The displaced bundle is MOVED into ``.trash/`` and kept, not
         deleted: a running cell started from the previous image may hold its
         cwd (and open files) inside that rootfs, and deleting it would yank
-        the directory out from under a live workload. gc_old() reaps the
-        renamed bundles later (prune / delete call it)."""
+        the directory out from under a live workload. The dot-dir keeps
+        displaced bundles out of list()/prune() entirely (no phantom
+        duplicate refs, no collisions with tags that contain '.old-');
+        gc_old() reaps them later (prune / delete call it)."""
         manifest.created_at = manifest.created_at or time.time()
         with open(os.path.join(staging, "manifest.json"), "w") as f:
             json.dump(manifest.to_json(), f, indent=2)
         d = self._dir(manifest.ref)
-        old = f"{d}.old-{os.getpid()}-{time.monotonic_ns()}"
+        trash = os.path.join(self.root, ".trash")
+        os.makedirs(trash, exist_ok=True)
+        old = os.path.join(
+            trash, f"{encode_ref(manifest.ref)}-{os.getpid()}-{time.monotonic_ns()}"
+        )
         with self._commit_lock:
             try:
                 os.rename(d, old)
@@ -185,16 +193,14 @@ class ImageStore:
         return d
 
     def gc_old(self) -> int:
-        """Remove bundles displaced by rebuilds (``*.old-*``). Safe to call
-        when no cell is mid-flight on a pre-rebuild image; wired into prune
-        and delete, which already imply operator-driven cleanup."""
-        if not os.path.isdir(self.root):
+        """Reap bundles displaced by rebuilds (``.trash/``). Safe when no
+        cell is mid-flight on a pre-rebuild image; wired into prune and
+        delete, which already imply operator-driven cleanup."""
+        trash = os.path.join(self.root, ".trash")
+        if not os.path.isdir(trash):
             return 0
-        n = 0
-        for entry in os.listdir(self.root):
-            if ".old-" in entry:
-                shutil.rmtree(os.path.join(self.root, entry), ignore_errors=True)
-                n += 1
+        n = len(os.listdir(trash))
+        shutil.rmtree(trash, ignore_errors=True)
         return n
 
     def abort(self, staging: str) -> None:

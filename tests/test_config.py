@@ -1,0 +1,100 @@
+"""Config registry precedence + configuration-document lifecycle
+(reference: cmd/config/env.go, internal/serverconfig, internal/clientconfig)."""
+
+import os
+
+import pytest
+
+from kukeon_tpu.runtime import config
+from kukeon_tpu.runtime.errors import InvalidArgument
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in config.REGISTRY:
+        monkeypatch.delenv(var.env, raising=False)
+
+
+class TestPrecedence:
+    def test_default(self):
+        s = config.Settings()
+        assert s.get("KUKEOND_RECONCILE_INTERVAL") == 30.0
+
+    def test_doc_beats_default(self):
+        s = config.Settings({"reconcileInterval": 5.0})
+        assert s.get("KUKEOND_RECONCILE_INTERVAL") == 5.0
+
+    def test_env_beats_doc(self, monkeypatch):
+        monkeypatch.setenv("KUKEOND_RECONCILE_INTERVAL", "7.5")
+        s = config.Settings({"reconcileInterval": 5.0})
+        assert s.get("KUKEOND_RECONCILE_INTERVAL") == 7.5
+
+    def test_flag_beats_env(self, monkeypatch):
+        monkeypatch.setenv("KUKEOND_RECONCILE_INTERVAL", "7.5")
+        s = config.Settings({"reconcileInterval": 5.0})
+        assert s.get("KUKEOND_RECONCILE_INTERVAL", flag_value=2.0) == 2.0
+
+    def test_bool_parsing(self, monkeypatch):
+        s = config.Settings()
+        for raw, want in (("true", True), ("1", True), ("yes", True),
+                          ("false", False), ("0", False), ("off", False)):
+            monkeypatch.setenv("KUKEON_NO_DAEMON", raw)
+            assert s.get("KUKEON_NO_DAEMON") is want
+
+    def test_doc_string_coerced_to_number(self):
+        s = config.Settings({"diskPressureBlockPct": "90"})
+        assert s.get("KUKEOND_DISK_PRESSURE_BLOCK_PCT") == 90.0
+
+    def test_bad_env_value_raises(self, monkeypatch):
+        monkeypatch.setenv("KUKEOND_RECONCILE_INTERVAL", "soon")
+        with pytest.raises(InvalidArgument, match="KUKEOND_RECONCILE_INTERVAL"):
+            config.Settings().get("KUKEOND_RECONCILE_INTERVAL")
+
+
+class TestDocuments:
+    def test_absent_file_is_empty_spec(self, tmp_path):
+        assert config.load_configuration(str(tmp_path / "nope.yaml"),
+                                         config.KIND_SERVER) == {}
+
+    def test_wrong_kind_rejected(self, tmp_path):
+        p = tmp_path / "c.yaml"
+        p.write_text("kind: Cell\nspec: {}\n")
+        with pytest.raises(InvalidArgument, match="kind"):
+            config.load_configuration(str(p), config.KIND_SERVER)
+
+    def test_invalid_yaml_is_error_not_silent(self, tmp_path):
+        p = tmp_path / "c.yaml"
+        p.write_text(":\n  - {broken")
+        with pytest.raises(InvalidArgument):
+            config.load_configuration(str(p), config.KIND_SERVER)
+
+    def test_write_default_once_and_roundtrip(self, tmp_path):
+        p = str(tmp_path / "kukeond.yaml")
+        created = config.write_default_server_configuration(
+            p, {"runPath": "/x", "reconcileInterval": 12.0}
+        )
+        assert created is True
+        # Never overwrites.
+        assert config.write_default_server_configuration(p, {"runPath": "/y"}) is False
+        spec = config.load_configuration(p, config.KIND_SERVER)
+        assert spec["runPath"] == "/x"
+        assert spec["reconcileInterval"] == 12.0
+        # Every registry knob with a doc key is present in the document.
+        for var in config.REGISTRY:
+            if var.key:
+                assert var.key in spec, f"missing {var.key}"
+
+    def test_server_settings_feed_resolution(self, tmp_path, monkeypatch):
+        rp = str(tmp_path)
+        monkeypatch.setenv("KUKEOND_CONFIGURATION", os.path.join(rp, "srv.yaml"))
+        with open(os.path.join(rp, "srv.yaml"), "w") as f:
+            f.write(
+                "kind: ServerConfiguration\n"
+                "spec:\n  reconcileInterval: 3.5\n  stopGraceSeconds: 1.0\n"
+            )
+        s = config.server_settings(rp)
+        assert s.get("KUKEOND_RECONCILE_INTERVAL") == 3.5
+        assert s.get("KUKEON_STOP_GRACE_SECONDS") == 1.0
+        # Env still wins over the document.
+        monkeypatch.setenv("KUKEOND_RECONCILE_INTERVAL", "9")
+        assert s.get("KUKEOND_RECONCILE_INTERVAL") == 9.0

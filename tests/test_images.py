@@ -510,13 +510,17 @@ class TestReviewRound4:
         held = os.path.join(old_rootfs, "bin/a.sh")
         assert os.path.exists(held)
         ImageBuilder(store).build(str(kf), str(ctx), "app:v1")
-        # Displaced bundle renamed, not deleted: the old tree still exists
-        # under a .old-* name until gc.
-        olds = [e for e in os.listdir(store.root) if ".old-" in e]
+        # Displaced bundle moved to .trash, not deleted: the old tree still
+        # exists until gc, and NEVER shows up in list()/prune().
+        trash = os.path.join(store.root, ".trash")
+        olds = os.listdir(trash)
         assert len(olds) == 1
-        assert os.path.exists(os.path.join(store.root, olds[0], "rootfs/bin/a.sh"))
-        assert store.gc_old() == 1
-        assert not [e for e in os.listdir(store.root) if ".old-" in e]
+        assert os.path.exists(os.path.join(trash, olds[0], "rootfs/bin/a.sh"))
+        assert [m.ref for m in store.list()] == ["app:v1"]   # no phantom dup
+        # Prune with the ref unused deletes it exactly once (regression:
+        # the .old duplicate made the second delete raise NotFound).
+        assert store.prune(in_use=set()) == ["app:v1"]
+        assert store.gc_old() == 0   # delete->prune already gc'd the trash
 
     def test_bare_env_is_build_error(self, store, tmp_path):
         ctx = tmp_path / "ctx"

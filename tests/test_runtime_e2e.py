@@ -309,3 +309,105 @@ spec:
     d.kuke("delete", "cell", "tpuweb", "--force")
     status = json.loads(d.kuke("--json", "status").stdout)
     assert status["tpuChips"]["free"] == 2
+
+
+def test_create_verb_and_autocomplete_e2e(daemon):
+    # Imperative scope creates.
+    daemon.kuke("create", "realm", "prod")
+    daemon.kuke("create", "space", "edge", "--realm", "prod")
+    daemon.kuke("create", "stack", "web", "--realm", "prod", "--space", "edge")
+    assert "prod" in daemon.kuke("get", "realms").stdout
+
+    # Cell with --no-start stays pending; then start brings it up.
+    daemon.kuke("create", "cell", "idle", "--no-start",
+                "--command", "/bin/sleep", "30")
+    out = daemon.kuke("get", "cell", "idle", "--json").stdout
+    rec = json.loads(out)
+    assert rec["status"]["phase"] == "pending"
+    daemon.kuke("start", "idle")
+    rec = json.loads(daemon.kuke("get", "cell", "idle", "--json").stdout)
+    assert rec["status"]["phase"] == "ready"
+
+    # Secret + volume imperative creates land in their stores.
+    daemon.kuke("create", "secret", "tok", "--data", "API_KEY=abc")
+    assert "tok" in daemon.kuke("get", "secrets").stdout
+    daemon.kuke("create", "volume", "scratch", "--reclaim-policy", "retain")
+    assert "scratch" in daemon.kuke("get", "volumes").stdout
+
+    # Autocomplete lists live resources; bash emits the script.
+    assert "idle" in daemon.kuke("autocomplete", "cells").stdout.split()
+    assert "prod" in daemon.kuke("autocomplete", "realms").stdout.split()
+    assert "_kuke_complete" in daemon.kuke("autocomplete", "bash").stdout
+
+    daemon.kuke("delete", "cell", "idle", "--force")
+
+
+def test_server_configuration_written_and_effective(daemon):
+    # First daemon start wrote the commented ServerConfiguration document.
+    cfg = os.path.join(daemon.run_path, "kukeond.yaml")
+    assert os.path.exists(cfg)
+    text = open(cfg).read()
+    assert "kind: ServerConfiguration" in text
+    assert "reconcileInterval" in text
+    # The doc carries the values the daemon actually bound to (env said 1.0).
+    assert "reconcileInterval: 1.0" in text
+
+
+def test_embedding_cell_e2e(daemon):
+    """BASELINE config 5 analog on CPU: an embedding model cell (bge shape)
+    comes up beside the runtime and serves /v1/embed."""
+    d = daemon
+    manifest = """
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {name: embedder}
+spec:
+  model: {model: bge-tiny, chips: 1, port: 9473, numSlots: 4}
+"""
+    d.kuke("apply", "-f", "-", stdin_data=manifest)
+
+    import urllib.request
+
+    deadline = time.monotonic() + 90.0
+    healthy = False
+    while time.monotonic() < deadline:
+        try:
+            r = urllib.request.urlopen("http://127.0.0.1:9473/v1/health", timeout=1)
+            healthy = json.loads(r.read())["status"] == "ok"
+            break
+        except OSError:
+            rec = json.loads(d.kuke("--json", "get", "cells", "embedder").stdout)
+            st = rec["status"]["containers"][0]
+            if st["state"] == "exited":
+                log = d.kuke("log", "embedder", "--container", "model-server",
+                             check=False).stdout
+                raise AssertionError(f"embedder exited ({st['exitCode']}):\n{log}")
+            time.sleep(1.0)
+    assert healthy, "embedding server did not become healthy in 90s"
+
+    body = json.dumps({"inputs": ["hello world", "tpu native"]}).encode()
+    r = urllib.request.urlopen(
+        urllib.request.Request("http://127.0.0.1:9473/v1/embed", data=body,
+                               headers={"Content-Type": "application/json"}),
+        timeout=60,
+    )
+    out = json.loads(r.read())
+    assert out["numSequences"] == 2
+    assert len(out["embeddings"]) == 2
+    assert len(out["embeddings"][0]) == out["dim"]
+    import math
+
+    norm = math.sqrt(sum(x * x for x in out["embeddings"][0]))
+    assert abs(norm - 1.0) < 1e-3
+
+    # The generate route must clearly reject on an embedding cell.
+    req = urllib.request.Request("http://127.0.0.1:9473/v1/generate",
+                                 data=b"{}",
+                                 headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        raise AssertionError("generate on an embedding cell should 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+    d.kuke("delete", "cell", "embedder", "--force")
