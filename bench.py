@@ -58,14 +58,20 @@ def main():
     # and the decode-chunk programs.
     engine.warmup(prompt_len, sp)
 
-    t0 = time.monotonic()
-    reqs = [engine.submit(p, sp) for p in prompts]
-    while not all(r.done.is_set() for r in reqs):
-        engine.step()
-    dt = time.monotonic() - t0
-
-    total_tokens = sum(len(r.generated) for r in reqs)
-    toks_per_s = total_tokens / dt
+    # The chip link (tunnel) has high latency jitter; a single short run can
+    # swing +-30%. Measure several trials and report the median.
+    trials = 1 if backend == "cpu" else 3
+    rates = []
+    for _ in range(trials):
+        t0 = time.monotonic()
+        reqs = [engine.submit(p, sp) for p in prompts]
+        while not all(r.done.is_set() for r in reqs):
+            engine.step()
+        dt = time.monotonic() - t0
+        total_tokens = sum(len(r.generated) for r in reqs)
+        rates.append(total_tokens / dt)
+    rates.sort()
+    toks_per_s = rates[len(rates) // 2]
 
     baseline_share = 1500.0 * n_chips / 8.0
     print(json.dumps({
@@ -74,6 +80,7 @@ def main():
         "value": round(toks_per_s, 2),
         "unit": "tok/s",
         "vs_baseline": round(toks_per_s / baseline_share, 4),
+        "trials": [round(r, 1) for r in rates],
     }))
 
 

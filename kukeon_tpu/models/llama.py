@@ -156,12 +156,19 @@ class KVCache:
 
 
 def _cache_insert(cache_kv: jnp.ndarray, new_kv: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
-    """Insert [B, S, KV, D] at per-batch ``offsets`` into [B, S_max, KV, D]."""
+    """Insert [B, S, KV, D] at per-batch ``offsets`` into [B, S_max, KV, D].
 
-    def insert_one(slot, kv, off):
-        return jax.lax.dynamic_update_slice(slot, kv, (off, 0, 0))
-
-    return jax.vmap(insert_one)(cache_kv, new_kv, offsets)
+    Unrolled over the (small, static) batch: per-row dynamic_update_slice
+    stays a real in-place slice write. A vmap'd DUS with per-row offsets
+    lowers to a whole-tensor select — measured at several ms/step against a
+    large cache — so the loop is the fast path, not a naive one.
+    """
+    B = cache_kv.shape[0]
+    for b in range(B):
+        cache_kv = jax.lax.dynamic_update_slice(
+            cache_kv, new_kv[b : b + 1], (b, offsets[b], 0, 0)
+        )
+    return cache_kv
 
 
 # --- Forward -----------------------------------------------------------------
@@ -190,6 +197,9 @@ def forward(
     c = cfg
     B, S = tokens.shape
     x = jnp.take(params["embed"], tokens, axis=0).astype(c.dtype)  # [B, S, H]
+
+    if cache is not None and S == 1:
+        return _decode_forward(params, c, x, positions, cache, B)
 
     offsets = cache.lengths if cache is not None else None
 
@@ -247,6 +257,68 @@ def forward(
             lambda carry, w: layer_step(carry, (w, None)), x, layer_ws
         )
         new_cache = None
+
+    x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    if c.tie_embeddings:
+        logits = jnp.einsum("bsh,vh->bsv", x, params["embed"])
+    else:
+        logits = x @ params["lm_head"]
+    return logits.astype(jnp.float32), new_cache
+
+
+def _decode_forward(
+    params: Params,
+    c: LlamaConfig,
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    cache: KVCache,
+    B: int,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Single-token decode, HBM-optimal.
+
+    The generic path writes each layer's K/V into the cache BEFORE attending
+    and re-stacks the full cache as scan outputs — two whole-cache copies per
+    step. Here the layer scan reads the cache as a read-only input
+    (append-free attention scores the new token separately), emits only the
+    tiny per-layer new K/V, and the cache is updated once per step with
+    per-slot in-place slice writes. Cache bytes stream through HBM exactly
+    once per step.
+    """
+    from kukeon_tpu.ops.attention import decode_gqa_attention
+
+    offsets = cache.lengths
+
+    def layer_step(x, layer):
+        w, ck, cv = layer
+        h = rms_norm(x, w["attn_norm"], c.rms_norm_eps)
+        q = (h @ w["wq"]).reshape(B, 1, c.num_heads, c.head_dim)
+        k = (h @ w["wk"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
+        v = (h @ w["wv"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, positions, c.rope_theta)
+        k = apply_rope(k, positions, c.rope_theta)
+
+        attn = decode_gqa_attention(q, k, v, ck, cv, offsets)
+        x = x + attn.reshape(B, 1, c.q_dim) @ w["wo"]
+
+        h = rms_norm(x, w["mlp_norm"], c.rms_norm_eps)
+        gate = jax.nn.silu((h @ w["w_gate"]).astype(jnp.float32)).astype(c.dtype)
+        up = h @ w["w_up"]
+        x = x + (gate * up) @ w["w_down"]
+        return x, (k, v)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        lambda carry, layer: layer_step(carry, layer),
+        x,
+        (params["layers"], cache.k, cache.v),
+    )
+    # new_k/new_v: [L, B, 1, KV, D] — one in-place slice write per slot
+    # covering every layer at once (layers share the slot's offset).
+    k_upd, v_upd = cache.k, cache.v
+    for b in range(B):
+        start = (0, b, offsets[b], 0, 0)
+        k_upd = jax.lax.dynamic_update_slice(k_upd, new_k[:, b : b + 1], start)
+        v_upd = jax.lax.dynamic_update_slice(v_upd, new_v[:, b : b + 1], start)
+    new_cache = KVCache(k=k_upd, v=v_upd, lengths=cache.lengths + 1)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
     if c.tie_embeddings:

@@ -73,6 +73,88 @@ def attention_reference(
     return out.astype(q.dtype)
 
 
+def attention_grouped(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """GQA attention WITHOUT materializing repeated KV heads.
+
+    ``repeat_kv`` + reference attention reads (and copies) the KV tensors
+    ``n_heads/n_kv`` times — for a decode step against a large cache that
+    multiplies the dominant HBM stream by the group factor. Grouping the
+    query heads instead ([B, Sq, KV, G, D]) keeps every KV byte read exactly
+    once; same math, same mask semantics.
+
+    Args:
+      q: [B, Sq, H, D]; k, v: [B, Skv, KV, D] (H % KV == 0);
+      mask: [B, 1, Sq, Skv] bool.
+    """
+    B, Sq, H, D = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    qg = q.reshape(B, Sq, KV, G, D)
+    scores = jnp.einsum(
+        "bqkgd,bTkd->bkgqT", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    # mask [B, 1, Sq, Skv] -> broadcast over (KV, G).
+    scores = jnp.where(mask[:, :, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgqT,bTkd->bqkgd", probs.astype(v.dtype), v)
+    return out.reshape(B, Sq, H, D).astype(q.dtype)
+
+
+def decode_gqa_attention(
+    q: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    lengths: jnp.ndarray,
+) -> jnp.ndarray:
+    """Single-token decode attention against a cache, append-free.
+
+    The new token's K/V are NOT written into the cache first (that write
+    pattern forces a full-cache copy per layer inside a scan); instead the
+    cache contributes `lengths` masked slots and the current token
+    contributes one extra score, softmaxed together. The caller inserts the
+    new K/V into the cache once per step, outside the layer scan.
+
+    Args:
+      q: [B, 1, H, D]; k_new, v_new: [B, 1, KV, D];
+      cache_k, cache_v: [B, S, KV, D]; lengths: [B] valid cache slots.
+
+    Returns: [B, 1, H, D].
+    """
+    B, _, H, D = q.shape
+    S = cache_k.shape[1]
+    KV = cache_k.shape[2]
+    G = H // KV
+    scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+
+    qg = q.reshape(B, KV, G, D)
+    s_cache = jnp.einsum(
+        "bkgd,bTkd->bkgT", qg, cache_k, preferred_element_type=jnp.float32
+    ) * scale
+    valid = jnp.arange(S)[None, None, None, :] < lengths[:, None, None, None]
+    s_cache = jnp.where(valid, s_cache, NEG_INF)
+    s_self = jnp.einsum(
+        "bkgd,bkd->bkg", qg, k_new.reshape(B, KV, D),
+        preferred_element_type=jnp.float32,
+    )[..., None] * scale
+
+    probs = jax.nn.softmax(jnp.concatenate([s_cache, s_self], axis=-1), axis=-1)
+    p_cache = probs[..., :S].astype(cache_v.dtype)
+    p_self = probs[..., S:].astype(cache_v.dtype)
+    out = (
+        jnp.einsum("bkgT,bTkd->bkgd", p_cache, cache_v)
+        + p_self * v_new.reshape(B, KV, 1, D)
+    )
+    return out.reshape(B, 1, H, D).astype(q.dtype)
+
+
 def gqa_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -101,8 +183,6 @@ def gqa_attention(
 
     n_heads = q.shape[2]
     n_kv = k.shape[2]
-    k = repeat_kv(k, n_heads // n_kv)
-    v = repeat_kv(v, n_heads // n_kv)
 
     from kukeon_tpu.ops import flash_attention as fa
 
@@ -123,7 +203,11 @@ def gqa_attention(
         )
 
     if use_flash:
+        k = repeat_kv(k, n_heads // n_kv)
+        v = repeat_kv(v, n_heads // n_kv)
         return fa.flash_attention(q, k, v, q_positions, kv_positions)
 
+    # XLA path: grouped-query einsum — KV is never head-repeated, so cache
+    # bytes stream through HBM exactly once.
     mask = attention_mask(q_positions, kv_positions, kv_length)
-    return attention_reference(q, k, v, mask)
+    return attention_grouped(q, k, v, mask)

@@ -17,6 +17,12 @@ core, designed for TPU:
   One dispatch per K tokens instead of per token — this is what makes the
   engine fast when the host-device link has latency (remote/tunneled chips)
   and removes Python from the inner loop entirely.
+- **Double-buffered dispatch**: chunk N+1 is dispatched *before* chunk N's
+  token block is fetched, so the host->device round-trip (~70ms on a
+  tunneled chip) overlaps the next chunk's compute instead of serializing
+  with it. Tokens therefore emit one chunk behind the device; a request
+  finishing mid-flight overshoots at most one extra chunk, whose tokens are
+  discarded (same overshoot contract the scheduler already has).
 - **Donation**: decode state (cache) is donated, so the multi-GB cache is
   updated in place in HBM.
 - **Sharding**: params tensor-sharded over the mesh; cache sharded on
@@ -73,6 +79,15 @@ class Request:
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
 
 
+@dataclasses.dataclass
+class _InflightChunk:
+    """A dispatched-but-unfetched decode chunk (double buffering)."""
+
+    tokens: Any                              # device array [B, K]
+    k: int
+    slots: list[tuple[int, "Request"]]       # (slot, request) at dispatch time
+
+
 def bucket_length(n: int) -> int:
     for b in PREFILL_BUCKETS:
         if n <= b:
@@ -120,6 +135,10 @@ class ServingEngine:
         self._requests: dict[int, Request] = {}
         self._slot_req: list[Request | None] = [None] * num_slots
         self._slot_len: list[int] = [0] * num_slots    # host-side cache lengths
+        self._inflight: _InflightChunk | None = None
+        # Device-resident sampling arrays, re-uploaded only when the slot
+        # composition changes (each host->device upload costs a link RT).
+        self._sampling_dev: tuple | None = None
         self._pending: queue.Queue[Request] = queue.Queue()
         self._next_id = 0
         self._lock = threading.Lock()
@@ -317,6 +336,7 @@ class ServingEngine:
                         self.state = self._init_state()
                     self._slot_req = [None] * self.num_slots
                     self._slot_len = [0] * self.num_slots
+                    self._inflight = None
                 except Exception:  # noqa: BLE001
                     self._running = False
                     raise
@@ -344,25 +364,50 @@ class ServingEngine:
         return [(i, r) for i, r in enumerate(self._slot_req) if r is not None]
 
     def step(self) -> bool:
-        """One scheduler iteration: fill free slots, then one decode chunk.
+        """One scheduler iteration, pipelined for link latency:
+
+          1. dispatch prefill+insert for every free slot with a waiting
+             request (device work queued, nothing fetched yet);
+          2. dispatch the next decode chunk for the active slots;
+          3. fetch + emit the prefills' first tokens (overlaps 2's compute);
+          4. fetch + emit the PREVIOUS chunk's tokens (double buffering —
+             the block for the chunk dispatched in 2 lands next step).
 
         Returns True if any work was done.
         """
         did_work = False
+        prefills = []
         for slot in self._free_slots():
             try:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 break
-            self._do_prefill(req, slot)
+            prefills.append(self._dispatch_prefill(req, slot))
             did_work = True
 
+        new_inflight = None
         if self._active_requests():
-            self._do_decode_chunk()
+            new_inflight = self._dispatch_decode_chunk()
             did_work = True
+
+        if prefills:
+            # One stacked fetch for every prefill's first token (per-request
+            # int() would pay one link round-trip each); the decode chunk
+            # dispatched above is already running behind it on the device.
+            with jax.set_mesh(self.mesh):
+                firsts = np.asarray(jnp.stack([f for _, f in prefills]))
+            for (req, _), first in zip(prefills, firsts):
+                self._emit(req, int(first))
+
+        if self._inflight is not None:
+            self._flush_inflight()
+            did_work = True
+        self._inflight = new_inflight
         return did_work
 
-    def _do_prefill(self, req: Request, slot: int):
+    def _dispatch_prefill(self, req: Request, slot: int):
+        """Queue prefill+insert on device; returns (req, first-token device
+        value) to fetch after other dispatches."""
         n = req.prompt.size
         bucket = min(bucket_length(n), self.max_seq_len)
         tokens = np.zeros((1, bucket), np.int32)
@@ -374,13 +419,12 @@ class ServingEngine:
                 self.params, jnp.asarray(tokens), n, k1,
                 jnp.float32(sp.temperature), jnp.int32(sp.top_k), jnp.float32(sp.top_p),
             )
-            first_id = int(first)
-            self.state = self._insert(self.state, kv_k, kv_v, n, slot, first_id)
+            self.state = self._insert(self.state, kv_k, kv_v, n, slot, first)
         req.slot = slot
         req.first_token_at = time.monotonic()
         self._slot_req[slot] = req
         self._slot_len[slot] = n + 1   # prompt + the first generated token's kv-to-be
-        self._emit(req, first_id)
+        return req, first
 
     def _chunk_size(self) -> int:
         """Largest safe K, bounded by decode_chunk and cache capacity.
@@ -394,8 +438,11 @@ class ServingEngine:
         # New requests should not wait for a long chunk to finish.
         if not self._pending.empty():
             k = min(k, 4)
+        # Capacity must count the un-flushed inflight chunk: the device cache
+        # is already k_inflight steps ahead of the host's _slot_len.
+        inflight_k = self._inflight.k if self._inflight is not None else 0
         for slot, _req in self._active_requests():
-            k = min(k, self.max_seq_len - self._slot_len[slot])
+            k = min(k, self.max_seq_len - self._slot_len[slot] - inflight_k)
         k = max(1, k)
         # Round down to a power of 4 ({1, 4, 16, ...}) so the compile cache
         # stays tiny and warmup() can pre-compile every variant.
@@ -414,19 +461,40 @@ class ServingEngine:
             top_ps[slot] = req.sampling.top_p
         return temps, top_ks, top_ps
 
-    def _do_decode_chunk(self):
-        k = self._chunk_size()
+    def _sampling_dev_arrays(self):
+        """Device copies of the per-slot sampling arrays, cached across
+        chunks while the slot->request mapping is unchanged."""
         temps, top_ks, top_ps = self._slot_sampling_arrays()
+        cached = self._sampling_dev
+        if cached is not None and (
+            np.array_equal(cached[0], temps)
+            and np.array_equal(cached[1], top_ks)
+            and np.array_equal(cached[2], top_ps)
+        ):
+            return cached[3]
+        dev = (jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps))
+        self._sampling_dev = (temps, top_ks, top_ps, dev)
+        return dev
+
+    def _dispatch_decode_chunk(self) -> _InflightChunk:
+        k = self._chunk_size()
+        temps_d, top_ks_d, top_ps_d = self._sampling_dev_arrays()
         with jax.set_mesh(self.mesh):
             self._key, k1 = jax.random.split(self._key)
             self.state, toks = self._decode_chunk(
-                self.params, self.state, k1,
-                jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), k,
+                self.params, self.state, k1, temps_d, top_ks_d, top_ps_d, k,
             )
-            toks = np.asarray(toks)   # [B, K] — single transfer per chunk
-        for slot, req in self._active_requests():
+        return _InflightChunk(tokens=toks, k=k, slots=self._active_requests())
+
+    def _flush_inflight(self):
+        """Fetch + emit the previously dispatched chunk's token block."""
+        chunk = self._inflight
+        toks = np.asarray(chunk.tokens)   # [B, K] — single transfer per chunk
+        for slot, req in chunk.slots:
+            if req.done.is_set():
+                continue   # finished meanwhile (overshoot chunk) — discard
             base = self._slot_len[slot]
-            for t in range(k):
+            for t in range(chunk.k):
                 # Per-token length bookkeeping so a request finishing mid-chunk
                 # keeps every token generated before the limit.
                 self._slot_len[slot] = base + t + 1
@@ -434,7 +502,7 @@ class ServingEngine:
                 if req.done.is_set():
                     break
             else:
-                self._slot_len[slot] = base + k
+                self._slot_len[slot] = base + chunk.k
 
     def _emit(self, req: Request, token: int):
         req.generated.append(token)

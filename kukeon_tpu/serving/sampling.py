@@ -91,5 +91,13 @@ def sample_per_slot(
     needs_filter = jnp.any(top_k > 0) | jnp.any(top_p < 1.0)
     scaled = jax.lax.cond(needs_filter, filter_topk_topp, lambda s: s, scaled)
 
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    # Categorical = gumbel noise over the whole [B, V] block (an RNG sweep
+    # per decode step) — skip it too when every slot is greedy.
+    any_stochastic = jnp.any(temperature > 0)
+    sampled = jax.lax.cond(
+        any_stochastic,
+        lambda s: jax.random.categorical(key, s, axis=-1).astype(jnp.int32),
+        lambda s: greedy,
+        scaled,
+    )
     return jnp.where(temperature > 0, sampled, greedy)
