@@ -198,7 +198,10 @@ def forward(
     B, S = tokens.shape
     x = jnp.take(params["embed"], tokens, axis=0).astype(c.dtype)  # [B, S, H]
 
-    if cache is not None and S == 1:
+    # The fused decode path implements its own (reference-equivalent) masked
+    # attention; honor an explicit request for a specific impl by falling
+    # through to the generic path instead of silently ignoring it.
+    if cache is not None and S == 1 and attn_impl in ("auto", "reference"):
         return _decode_forward(params, c, x, positions, cache, B)
 
     offsets = cache.lengths if cache is not None else None

@@ -175,6 +175,13 @@ def gqa_attention(
     and requires an ambient mesh (``jax.set_mesh``) with a ``seq`` axis.
     """
     if impl == "ring":
+        if kv_length is not None or q.shape[1] != k.shape[1]:
+            raise ValueError(
+                "impl='ring' requires full self-attention (Sq == Skv, no "
+                f"kv_length); got Sq={q.shape[1]}, Skv={k.shape[1]}, "
+                f"kv_length={'set' if kv_length is not None else 'None'}. "
+                "Use 'reference' or 'auto' for cached decode."
+            )
         from kukeon_tpu.parallel.ring_attention import ring_attention
 
         return ring_attention(
@@ -188,6 +195,14 @@ def gqa_attention(
 
     use_flash = False
     if impl == "flash":
+        if kv_length is not None or not fa.supports(q.shape[1], k.shape[1]):
+            raise ValueError(
+                "impl='flash' requires full self-attention with Sq == Skv, "
+                "Sq >= 128, Sq a multiple of the 256 block, and no kv_length; "
+                f"got Sq={q.shape[1]}, Skv={k.shape[1]}, "
+                f"kv_length={'set' if kv_length is not None else 'None'}. "
+                "Use 'reference' or 'auto'."
+            )
         use_flash = True
     elif impl == "auto":
         # Flash pays off when the score matrix is big; decode (Sq==1), tiny

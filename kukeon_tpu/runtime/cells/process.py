@@ -26,6 +26,7 @@ from kukeon_tpu.runtime.cells.backend import (
     ContainerContext,
     ContainerState,
 )
+from kukeon_tpu.runtime import naming
 from kukeon_tpu.runtime.errors import FailedPrecondition
 from kukeon_tpu.runtime.model import C_CREATED, C_EXITED, C_RUNNING
 
@@ -212,7 +213,9 @@ class ProcessBackend(CellBackend):
         rootfs = ctx.env.get("KUKEON_IMAGE_ROOTFS")
         if not wd or not rootfs or not wd.startswith("/"):
             return wd
-        candidate = os.path.join(rootfs, wd.lstrip("/"))
+        # A tar-imported manifest can carry '..' components; clamp the
+        # resolved path under the rootfs (same escape class as COPY dst).
+        candidate = naming.resolve_under(rootfs, wd, "workdir")
         os.makedirs(candidate, exist_ok=True)
         return candidate
 

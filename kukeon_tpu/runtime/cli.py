@@ -760,7 +760,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-f", "--file", default=None)
     sp.add_argument("--image", default=None, help="cell: image for the main container")
     sp.add_argument("--command", nargs=argparse.REMAINDER, default=None,
-                    help="cell: command for the main container")
+                    help="cell: command for the main container; consumes ALL "
+                         "remaining argv, so it must be the last flag")
     sp.add_argument("--no-start", action="store_true",
                     help="cell: create without starting")
     sp.add_argument("--data", action="append", help="secret: KEY=VALUE")

@@ -47,6 +47,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from kukeon_tpu.runtime import naming
 from kukeon_tpu.runtime.errors import InvalidArgument, NotFound
 
 IMAGES_DIR = "images"
@@ -460,14 +461,8 @@ class ImageBuilder:
                 parts = shlex.split(_subst(rest, vars_))
                 if len(parts) != 2:
                     raise InvalidArgument(f"COPY wants <src> <dst>: {rest!r}")
-                ctx_abs = os.path.abspath(context_dir)
-                src = os.path.abspath(os.path.join(ctx_abs, parts[0]))
-                if src != ctx_abs and not src.startswith(ctx_abs + os.sep):
-                    raise InvalidArgument(f"COPY src escapes context: {parts[0]!r}")
-                rootfs_abs = os.path.abspath(rootfs)
-                dst = os.path.abspath(os.path.join(rootfs_abs, parts[1].lstrip("/")))
-                if dst != rootfs_abs and not dst.startswith(rootfs_abs + os.sep):
-                    raise InvalidArgument(f"COPY dst escapes rootfs: {parts[1]!r}")
+                src = naming.resolve_under(context_dir, parts[0], "COPY src")
+                dst = naming.resolve_under(rootfs, parts[1], "COPY dst")
                 if os.path.isdir(src):
                     shutil.copytree(src, dst, dirs_exist_ok=True, symlinks=True)
                 else:

@@ -31,3 +31,18 @@ def runtime_id(space: str, stack: str, cell: str, container: str | None = None) 
 def random_cell_name(prefix: str = "cell") -> str:
     """``<prefix>-<6hex>`` (reference: cellname.go:39-61)."""
     return f"{prefix}-{secrets.token_hex(3)}"
+
+
+def resolve_under(root: str, relpath: str, what: str = "path") -> str:
+    """Resolve ``relpath`` (absolute-style or relative, may contain '..')
+    against ``root`` and reject anything that escapes it.
+
+    The single containment clamp for every untrusted-path seam (Kukefile
+    COPY src/dst, image-manifest workdir, volume subpaths)."""
+    import os
+
+    root_abs = os.path.abspath(root)
+    candidate = os.path.abspath(os.path.join(root_abs, relpath.lstrip("/")))
+    if candidate != root_abs and not candidate.startswith(root_abs + os.sep):
+        raise InvalidArgument(f"{what} escapes {root!r}: {relpath!r}")
+    return candidate

@@ -552,3 +552,35 @@ class TestReviewRound4:
         ))
         mapped = ProcessBackend._overlay_workdir(backend.started[-1])
         assert mapped == os.path.join(istore.rootfs("wd:v1"), "tmp")
+
+
+class TestAdviceRound2:
+    def test_workdir_escape_rejected(self, tmp_path):
+        """A tar-imported manifest workdir with '..' must not resolve to a
+        host path outside the image rootfs (ADVICE r1, medium)."""
+        from kukeon_tpu.runtime.cells.backend import ContainerContext
+        from kukeon_tpu.runtime.cells.process import ProcessBackend
+
+        rootfs = tmp_path / "rootfs"
+        rootfs.mkdir()
+        ctx = ContainerContext(
+            container_dir=str(tmp_path),
+            env={"KUKEON_IMAGE_ROOTFS": str(rootfs)},
+            workdir="/../../pwned",
+        )
+        with pytest.raises(InvalidArgument, match="escapes"):
+            ProcessBackend._overlay_workdir(ctx)
+        assert not (tmp_path.parent / "pwned").exists()
+
+    def test_workdir_dotdot_inside_rootfs_ok(self, tmp_path):
+        from kukeon_tpu.runtime.cells.backend import ContainerContext
+        from kukeon_tpu.runtime.cells.process import ProcessBackend
+
+        rootfs = tmp_path / "rootfs"
+        rootfs.mkdir()
+        ctx = ContainerContext(
+            container_dir=str(tmp_path),
+            env={"KUKEON_IMAGE_ROOTFS": str(rootfs)},
+            workdir="/a/../b",
+        )
+        assert ProcessBackend._overlay_workdir(ctx) == str(rootfs / "b")
