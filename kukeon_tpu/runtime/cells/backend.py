@@ -29,6 +29,10 @@ class ContainerContext:
     command: list[str] = field(default_factory=list)
     cgroup_dir: str | None = None
     workdir: str | None = None
+    # Isolation inputs (namespace backend; ignored by the process backend):
+    sandbox_pid: int | None = None        # cell sandbox to join
+    devices: list[str] = field(default_factory=list)   # granted /dev nodes
+    binds: list[tuple[str, str, bool]] = field(default_factory=list)  # (src, dst, ro)
 
 
 @dataclass
@@ -47,6 +51,10 @@ class ContainerState:
 
 
 class CellBackend(abc.ABC):
+    #: True when containers run inside per-cell namespaces (the namespace
+    #: backend); the runner then provisions sandboxes and real binds.
+    isolated = False
+
     @abc.abstractmethod
     def start_container(self, ctx: ContainerContext) -> int:
         """Start (or restart) the workload; returns supervisor/workload pid."""
@@ -62,3 +70,17 @@ class CellBackend(abc.ABC):
     @abc.abstractmethod
     def cleanup_container(self, ctx: ContainerContext) -> None:
         """Remove runtime droppings after the workload is gone."""
+
+    # --- cell sandbox (namespace set shared by the cell's containers) ------
+    # Reference analog: the root (pause) container every cell gets
+    # (runner/provision.go:1346, kukepause as PID 1). Backends without
+    # real isolation keep these as no-ops.
+
+    def ensure_sandbox(self, cell_dir: str, hostname: str) -> int | None:
+        return None
+
+    def sandbox_pid(self, cell_dir: str) -> int | None:
+        return None
+
+    def teardown_sandbox(self, cell_dir: str) -> None:
+        return None

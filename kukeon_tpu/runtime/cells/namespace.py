@@ -1,0 +1,164 @@
+"""Namespace-isolated cells: the real isolation layer.
+
+TPU-native redesign of the reference's containerd path (internal/ctr/
+spec.go:309-511 builds OCI specs with namespaces/mounts/devices/security;
+internal/ctr/container.go drives the runtime): instead of an external
+container runtime, the native ``kukecell`` helper owns the namespace
+surgery and the supervisors stay host-side:
+
+- per cell, a **sandbox**: UTS+IPC+NET+PID namespaces with ``kukepause``
+  as in-namespace PID 1 (its reference role, cmd/kukepause/main.go:17-62);
+- per container, the supervisor (kukeshim/kuketty) runs on the host —
+  exit files, logs and the attach socket keep their daemon-restart-safe
+  host paths — and execs the workload through ``kukecell enter``, which
+  joins the sandbox, pivot_roots onto the image rootfs, builds a minimal
+  /dev containing ONLY granted device nodes (airtight chip partitioning,
+  reference devices.go:23-171), applies volume/secret binds, drops
+  capabilities, and honors privileged/hostNetwork/hostPID/readOnlyRoot.
+
+``available()`` reports whether this host can run namespaced cells
+(root + kukecell binary); the daemon auto-selects the backend on that.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+
+from kukeon_tpu.runtime.cells.backend import ContainerContext
+from kukeon_tpu.runtime.cells.process import (
+    BIN_DIR,
+    ProcessBackend,
+    _pid_alive,
+)
+from kukeon_tpu.runtime.errors import FailedPrecondition
+
+KUKECELL = os.path.join(BIN_DIR, "kukecell")
+KUKEPAUSE = os.path.join(BIN_DIR, "kukepause")
+
+SANDBOX_PID_FILE = "sandbox.pid"
+
+
+def available() -> bool:
+    """Can this host run namespaced cells?"""
+    override = os.environ.get("KUKEON_ISOLATION")
+    if override is not None:
+        return override not in ("0", "false", "process", "")
+    return os.geteuid() == 0 and os.access(KUKECELL, os.X_OK)
+
+
+class NamespaceBackend(ProcessBackend):
+    isolated = True
+
+    def __init__(self, shim: str | None = None, tty: str | None = None,
+                 kukecell: str = KUKECELL, pause: str = KUKEPAUSE):
+        super().__init__()
+        if shim:
+            self.shim = shim
+        if tty:
+            self.tty = tty
+        self.kukecell = kukecell
+        self.pause = pause
+
+    # --- sandbox lifecycle --------------------------------------------------
+
+    def ensure_sandbox(self, cell_dir: str, hostname: str) -> int:
+        pid = self.sandbox_pid(cell_dir)
+        if pid is not None:
+            return pid
+        os.makedirs(cell_dir, exist_ok=True)
+        pid_file = os.path.join(cell_dir, SANDBOX_PID_FILE)
+        res = subprocess.run(
+            [self.kukecell, "sandbox", "--pid-file", pid_file,
+             "--hostname", hostname, "--pause", self.pause],
+            capture_output=True, text=True,
+        )
+        if res.returncode != 0:
+            raise FailedPrecondition(
+                f"sandbox creation failed (rc={res.returncode}): "
+                f"{res.stderr.strip()}"
+            )
+        pid = self._read_pid(pid_file)
+        if pid is None or not _pid_alive(pid):
+            raise FailedPrecondition("sandbox pause process did not come up")
+        return pid
+
+    @staticmethod
+    def _is_pause(pid: int) -> bool:
+        """Guard against recycled pids: only ever join/kill a process that
+        really is our pause binary (host reboot can hand sandbox.pid's pid
+        to an arbitrary process)."""
+        try:
+            with open(f"/proc/{pid}/comm") as f:
+                return f.read().strip() == "kukepause"
+        except OSError:
+            return False
+
+    def sandbox_pid(self, cell_dir: str) -> int | None:
+        """Live sandbox pid, re-derived from disk + /proc (restart-safe)."""
+        pid = self._read_pid(os.path.join(cell_dir, SANDBOX_PID_FILE))
+        if pid and _pid_alive(pid) and self._is_pause(pid):
+            return pid
+        return None
+
+    def teardown_sandbox(self, cell_dir: str) -> None:
+        pid_file = os.path.join(cell_dir, SANDBOX_PID_FILE)
+        pid = self._read_pid(pid_file)
+        if pid and not self._is_pause(pid):
+            pid = None
+        if pid and _pid_alive(pid):
+            try:
+                os.kill(pid, signal.SIGTERM)  # kukepause exits immediately
+            except ProcessLookupError:
+                pass
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline and _pid_alive(pid):
+                time.sleep(0.02)
+            if _pid_alive(pid):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        try:
+            os.unlink(pid_file)
+        except FileNotFoundError:
+            pass
+
+    # --- workload wrapping --------------------------------------------------
+
+    def _workload(self, ctx: ContainerContext) -> tuple[list[str], str | None]:
+        spec = ctx.spec
+        if ctx.sandbox_pid is None:
+            raise FailedPrecondition(
+                "namespace backend needs a cell sandbox before containers"
+            )
+        argv = [self.kukecell, "enter", "--sandbox", str(ctx.sandbox_pid)]
+        rootfs = ctx.env.get("KUKEON_IMAGE_ROOTFS")
+        if rootfs:
+            # Per-container copy-on-write layer over the shared image rootfs.
+            argv += ["--rootfs", rootfs,
+                     "--overlay-dir", os.path.join(ctx.container_dir, "overlay")]
+        if spec.host_network:
+            argv += ["--host-net"]
+        if spec.host_pid:
+            argv += ["--host-pid"]
+        if spec.privileged:
+            argv += ["--privileged"]
+        if spec.read_only_root_filesystem:
+            argv += ["--readonly-root"]
+        for cap in spec.capabilities:
+            argv += ["--cap", cap]
+        for dev in list(spec.devices) + list(ctx.devices):
+            argv += ["--device", dev]
+        for src, dst, ro in ctx.binds:
+            argv += ["--bind", f"{src}:{dst}" + (":ro" if ro else "")]
+        if spec.user:
+            argv += ["--user", spec.user]
+        # In-image (post-pivot) path: kukecell chdirs after the namespace
+        # setup; the supervisor's host-side --cwd must stay unset.
+        if ctx.workdir:
+            argv += ["--workdir", ctx.workdir]
+        argv += ["--"] + list(ctx.command)
+        return argv, None

@@ -75,8 +75,7 @@ class ProcessBackend(CellBackend):
                 "container has no command and its image (if any) has no "
                 "entrypoint"
             )
-        ctx.command = self._overlay_command(ctx)
-        ctx.workdir = self._overlay_workdir(ctx)
+        workload, cwd = self._workload(ctx)
         p = self.paths(ctx)
         os.makedirs(ctx.container_dir, exist_ok=True)
         # A fresh start invalidates previous run artifacts.
@@ -95,11 +94,11 @@ class ProcessBackend(CellBackend):
         else:
             argv = [self.shim, "--log", p["log"],
                     "--exit-file", p["exit"], "--pid-file", p["pid"]]
-        if ctx.workdir:
-            argv += ["--cwd", ctx.workdir]
+        if cwd:
+            argv += ["--cwd", cwd]
         if ctx.cgroup_dir:
             argv += ["--cgroup", ctx.cgroup_dir]
-        argv += ["--"] + ctx.command
+        argv += ["--"] + workload
 
         env = dict(os.environ)
         env.update(ctx.env)
@@ -181,6 +180,11 @@ class ProcessBackend(CellBackend):
                 pass
 
     # --- helpers -----------------------------------------------------------
+
+    def _workload(self, ctx: ContainerContext) -> tuple[list[str], str | None]:
+        """(workload argv, supervisor --cwd). Seam the namespace backend
+        overrides to wrap the workload in `kukecell enter`."""
+        return self._overlay_command(ctx), self._overlay_workdir(ctx)
 
     @staticmethod
     def _overlay_command(ctx: ContainerContext) -> list[str]:

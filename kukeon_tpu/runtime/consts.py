@@ -33,6 +33,8 @@ STACKS_DIR = "stacks"
 CELLS_DIR = "cells"
 CONTAINERS_DIR = "containers"
 SECRETS_DIR = "secrets"
+# In-cell mount point for staged secrets (reference: ctr/secrets.go:30-60).
+SECRETS_MOUNT = "/run/kukeon/secrets"
 BLUEPRINTS_DIR = "blueprints"
 CONFIGS_DIR = "configs"
 VOLUMES_DIR = "volumes"

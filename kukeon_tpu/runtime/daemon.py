@@ -47,13 +47,21 @@ def build_controller(run_path: str,
     from kukeon_tpu.runtime.net import NetworkManager
     from kukeon_tpu.runtime.runner import RunnerOptions
 
+    from kukeon_tpu.runtime.cells import namespace as nsbackend
+
     s = settings or config.server_settings(run_path)
     ms = MetadataStore(run_path)
     store = ResourceStore(ms)
     cg = CgroupManager()
+    # Real isolation when the host can do it (root + kukecell); the
+    # process backend remains the non-root/dev fallback.
+    # KUKEON_ISOLATION=0|process forces the fallback, =1 forces namespaces.
+    backend = (
+        nsbackend.NamespaceBackend() if nsbackend.available() else ProcessBackend()
+    )
     runner = Runner(
         store,
-        ProcessBackend(),
+        backend,
         cgroups=cg if cg.available() else None,
         devices=TPUDeviceManager(ms),
         netman=NetworkManager(

@@ -94,6 +94,22 @@ class TPUDeviceManager:
                 self._save(remaining)
 
     @staticmethod
+    def device_nodes(chips: list[int]) -> list[str]:
+        """Host /dev nodes backing these chips (for namespace injection:
+        the namespace backend's /dev contains ONLY what this returns plus
+        the standard nodes — reference: internal/ctr/devices.go:23-171).
+        Empty on hosts whose TPU plane is not device-node-backed (e.g. the
+        axon loopback tunnel)."""
+        out = []
+        for c in chips:
+            for cand in (f"/dev/accel{c}", f"/dev/accel_{c}", f"/dev/vfio/{c}"):
+                if os.path.exists(cand):
+                    out.append(cand)
+        if out and os.path.exists("/dev/vfio/vfio"):
+            out.append("/dev/vfio/vfio")
+        return out
+
+    @staticmethod
     def visibility_env(chips: list[int]) -> dict[str, str]:
         """Env that restricts libtpu/JAX to exactly these chips.
 

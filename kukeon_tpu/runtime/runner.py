@@ -186,6 +186,10 @@ class Runner:
             resources=t.Resources(tpu_chips=m.chips),
             restart_policy=t.RestartPolicy(policy="always", backoff_seconds=2.0),
             ports=[t.PortSpec(port=m.port, name="http")],
+            # The TPU runtime plane (libtpu workers on real TPU-VMs; the
+            # loopback tunnel on emulated hosts) rides the host network, and
+            # clients/health checks reach the server on a host port.
+            host_network=True,
         )
 
     def _owner_key(self, rec: model.CellRecord) -> str:
@@ -213,6 +217,7 @@ class Runner:
             grant = slices.get(spec.name, [])
             if grant:
                 ctx.env.update(self.devices.visibility_env(grant))
+                ctx.devices = self.devices.device_nodes(grant)
             st = rec.status.container(spec.name) or model.ContainerStatus(name=spec.name)
             live = self.backend.container_state(ctx)
             if not live.running:
@@ -244,6 +249,11 @@ class Runner:
                 cursor += n
         return out
 
+    def _cell_dir(self, rec: model.CellRecord) -> str:
+        return self.store.ms.ensure_dir(
+            *self.store.cell_parts(rec.realm, rec.space, rec.stack, rec.name)
+        )
+
     def _container_context(self, rec: model.CellRecord, spec: t.ContainerSpec) -> ContainerContext:
         cdir = self.store.container_dir(rec.realm, rec.space, rec.stack, rec.name, spec.name)
         env: dict[str, str] = {
@@ -271,8 +281,14 @@ class Runner:
             workdir = workdir or manifest.workdir or None
         for e in spec.env:
             env[e.name] = e.value
-        self._stage_secrets(rec, spec, cdir, env)
-        self._mount_volumes(rec, spec, cdir, env)
+        binds: list[tuple[str, str, bool]] = []
+        self._stage_secrets(rec, spec, cdir, env, binds)
+        self._mount_volumes(rec, spec, cdir, env, binds)
+
+        sandbox_pid = None
+        if self.backend.isolated:
+            # Cell-shared namespace set (idempotent; restart-safe pid file).
+            sandbox_pid = self.backend.ensure_sandbox(self._cell_dir(rec), rec.name)
 
         cgroup_dir = None
         if self.cgroups and self.cgroups.available():
@@ -300,14 +316,21 @@ class Runner:
             command=command,
             cgroup_dir=cgroup_dir,
             workdir=workdir,
+            sandbox_pid=sandbox_pid,
+            binds=binds,
         )
 
     def _stage_secrets(self, rec: model.CellRecord, spec: t.ContainerSpec,
-                       cdir: str, env: dict[str, str]) -> None:
+                       cdir: str, env: dict[str, str],
+                       binds: list[tuple[str, str, bool]]) -> None:
         """Stage referenced secrets (reference: ctr/secrets.go:30-60,
-        mode 0400) and/or export env vars."""
+        mode 0400) and/or export env vars. Under the namespace backend the
+        staged file is bind-mounted read-only at its in-cell path
+        (/run/kukeon/secrets/<name>.env or ref.path); the env pointer then
+        names the in-cell path."""
         if not spec.secrets:
             return
+        isolated = self.backend.isolated
         sdir = os.path.join(cdir, "secrets")
         os.makedirs(sdir, mode=0o700, exist_ok=True)
         for ref in spec.secrets:
@@ -326,28 +349,43 @@ class Runner:
                 else:
                     for k, v in data.items():
                         env[f"{ref.env}_{k}"] = v
-            path = ref.path or os.path.join(sdir, f"{ref.name}.env")
+            staged = os.path.join(sdir, f"{ref.name}.env")
+            if not isolated and ref.path:
+                # Process backend honors an explicit host staging path.
+                staged = ref.path
             content = "".join(f"{k}={v}\n" for k, v in sorted(data.items()))
             # The staged file is 0400; restaging (stop/start, restart policy)
             # must replace it, not reopen it (O_TRUNC on a 0400 file EACCESes
             # for non-root daemons).
             try:
-                os.unlink(path)
+                os.unlink(staged)
             except FileNotFoundError:
                 pass
-            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o400)
+            fd = os.open(staged, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o400)
             try:
                 os.write(fd, content.encode())
             finally:
                 os.close(fd)
-            env[f"KUKEON_SECRET_{ref.name.upper().replace('-', '_')}"] = path
+            cell_path = staged
+            if isolated:
+                cell_path = ref.path or os.path.join(
+                    consts.SECRETS_MOUNT, f"{ref.name}.env"
+                )
+                binds.append((staged, cell_path, True))
+            env[f"KUKEON_SECRET_{ref.name.upper().replace('-', '_')}"] = cell_path
 
     def _mount_volumes(self, rec: model.CellRecord, spec: t.ContainerSpec,
-                       cdir: str, env: dict[str, str]) -> None:
-        """Process-backend volume binding: each Volume kind owns a data dir
-        under its scope; the container gets its path via env (a containerd
-        backend would bind-mount instead)."""
+                       cdir: str, env: dict[str, str],
+                       binds: list[tuple[str, str, bool]]) -> None:
+        """Volume binding. Namespace backend: real bind mounts at the
+        declared in-cell path honoring read_only (reference: ctr/spec.go
+        volume mounts). Process backend: env pointer only."""
         for vm in spec.volumes:
+            if vm.host_path and self.backend.isolated:
+                # Direct host bind (trusted manifests only).
+                if vm.path:
+                    binds.append((vm.host_path, vm.path, vm.read_only))
+                continue
             if vm.name is None:
                 continue
             vol = self.store.resolve_scoped(
@@ -359,7 +397,17 @@ class Runner:
                 raise NotFound(f"volume {vm.name!r} not found in scope")
             data_dir = vol.get("dataDir")
             if data_dir:
-                env[f"KUKEON_VOLUME_{vm.name.upper().replace('-', '_')}"] = data_dir
+                key = f"KUKEON_VOLUME_{vm.name.upper().replace('-', '_')}"
+                env[key] = data_dir
+                if self.backend.isolated:
+                    # Image-backed cells lose host-path visibility after
+                    # pivot_root, so a path-less volume gets a default
+                    # in-cell mount point; host-rootfs cells without an
+                    # explicit path keep the host dir via env.
+                    path = vm.path or (f"/mnt/{vm.name}" if spec.image else None)
+                    if path:
+                        binds.append((data_dir, path, vm.read_only))
+                        env[key] = path
 
     def stop_cell(self, realm: str, space: str, stack: str, name: str,
                   grace_s: float | None = None) -> model.CellRecord:
@@ -418,6 +466,8 @@ class Runner:
         if rec.status.tpu_chips:
             self.devices.release(self._owner_key(rec))
             rec.status.tpu_chips = []
+        if self.backend.isolated:
+            self.backend.teardown_sandbox(self._cell_dir(rec))
         self.store.write_cell(rec)
 
     def delete_cell(self, realm: str, space: str, stack: str, name: str,
@@ -433,6 +483,8 @@ class Runner:
         with self.cell_lock(realm, space, stack, name):
             for spec in self.cell_containers(rec):
                 self.backend.cleanup_container(self._container_context_bare(rec, spec))
+            if self.backend.isolated:
+                self.backend.teardown_sandbox(self._cell_dir(rec))
             self.devices.release(self._owner_key(rec))
             self.store.delete_cell_tree(realm, space, stack, name)
             if self.cgroups:
@@ -479,6 +531,7 @@ class Runner:
                 if grant:
                     # Reuse the cell's grant (stable across restarts).
                     ctx_full.env.update(self.devices.visibility_env(grant))
+                    ctx_full.devices = self.devices.device_nodes(grant)
                 self.backend.start_container(ctx_full)
                 live = self.backend.container_state(ctx_full)
                 st.state = live.state

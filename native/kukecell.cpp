@@ -1,0 +1,534 @@
+// kukecell: the namespace isolation primitive behind NamespaceBackend.
+//
+// TPU-native re-design of the reference's containerd/OCI layer
+// (internal/ctr/spec.go:309-511 builds an OCI spec; internal/ctr/
+// container.go:37-513 drives containerd): instead of delegating to an
+// external runtime, one small setuid-less root helper owns the two
+// namespace operations a cell needs:
+//
+//   kukecell sandbox --pid-file F --hostname NAME --pause BIN
+//            [--host-net] [--host-pid]
+//     Create the cell's shared namespace set (UTS+IPC, plus NET and PID
+//     unless --host-*) with kukepause as in-namespace PID 1 (its reaper/
+//     fast-SIGTERM role, reference cmd/kukepause/main.go:17-62). Writes
+//     kukepause's host pid to --pid-file and exits; the sandbox lives on,
+//     reparented to init, until kukepause is SIGTERMed or the last
+//     process leaves.
+//
+//   kukecell enter --sandbox PID [--rootfs DIR] [--bind SRC:DST[:ro]]...
+//            [--device PATH]... [--no-dev] [--readonly-root] [--cap NAME]...
+//            [--privileged] [--host-net] [--host-pid] [--workdir DIR]
+//            [--user UID[:GID]] -- CMD [ARGS...]
+//     Join the sandbox's namespaces, build a private mount namespace
+//     (pivot_root onto --rootfs when given; minimal /dev with only the
+//     granted --device nodes; volume/secret binds; optional read-only
+//     root), drop capabilities to the default bounded set (+ --cap adds),
+//     set no_new_privs, then exec the workload. Exit code mirrors the
+//     workload; TERM/INT are forwarded.
+//
+// The supervisor (kukeshim/kuketty) stays OUTSIDE the namespaces on host
+// paths, so exit files, logs and the attach socket keep their
+// daemon-restart-safe locations; only the workload itself is namespaced.
+//
+// Build: g++ -O2 -o kukecell kukecell.cpp
+
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <sched.h>
+#include <string>
+#include <sys/mount.h>
+#include <sys/prctl.h>
+#include <sys/stat.h>
+#include <grp.h>
+#include <sys/syscall.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <vector>
+
+#ifndef MS_REC
+#define MS_REC 16384
+#endif
+
+static void die(const char* what) {
+    fprintf(stderr, "kukecell: %s: %s\n", what, strerror(errno));
+    _exit(125);
+}
+
+static void write_file(const std::string& path, const std::string& content) {
+    std::string tmp = path + ".tmp";
+    int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) die("open pid-file");
+    if (write(fd, content.c_str(), content.size()) < 0) die("write pid-file");
+    close(fd);
+    if (rename(tmp.c_str(), path.c_str()) != 0) die("rename pid-file");
+}
+
+// --- capabilities ----------------------------------------------------------
+
+struct CapName { const char* name; int value; };
+// Linux capability table (uapi/linux/capability.h). Names accepted with or
+// without the CAP_ prefix, case-insensitive.
+static const CapName kCaps[] = {
+    {"CHOWN", 0}, {"DAC_OVERRIDE", 1}, {"DAC_READ_SEARCH", 2}, {"FOWNER", 3},
+    {"FSETID", 4}, {"KILL", 5}, {"SETGID", 6}, {"SETUID", 7}, {"SETPCAP", 8},
+    {"LINUX_IMMUTABLE", 9}, {"NET_BIND_SERVICE", 10}, {"NET_BROADCAST", 11},
+    {"NET_ADMIN", 12}, {"NET_RAW", 13}, {"IPC_LOCK", 14}, {"IPC_OWNER", 15},
+    {"SYS_MODULE", 16}, {"SYS_RAWIO", 17}, {"SYS_CHROOT", 18},
+    {"SYS_PTRACE", 19}, {"SYS_PACCT", 20}, {"SYS_ADMIN", 21},
+    {"SYS_BOOT", 22}, {"SYS_NICE", 23}, {"SYS_RESOURCE", 24},
+    {"SYS_TIME", 25}, {"SYS_TTY_CONFIG", 26}, {"MKNOD", 27}, {"LEASE", 28},
+    {"AUDIT_WRITE", 29}, {"AUDIT_CONTROL", 30}, {"SETFCAP", 31},
+    {"MAC_OVERRIDE", 32}, {"MAC_ADMIN", 33}, {"SYSLOG", 34},
+    {"WAKE_ALARM", 35}, {"BLOCK_SUSPEND", 36}, {"AUDIT_READ", 37},
+    {"PERFMON", 38}, {"BPF", 39}, {"CHECKPOINT_RESTORE", 40},
+};
+
+// Default bounded set for unprivileged cells (the containerd/Docker default
+// profile, which the reference inherits through containerd's oci defaults).
+static const int kDefaultCaps[] = {0, 1, 3, 4, 5, 6, 7, 8, 10, 13, 18, 27, 29, 31};
+
+static int cap_lookup(const std::string& raw) {
+    std::string s = raw;
+    for (auto& ch : s) ch = toupper(ch);
+    if (s.rfind("CAP_", 0) == 0) s = s.substr(4);
+    for (const auto& c : kCaps)
+        if (s == c.name) return c.value;
+    return -1;
+}
+
+static void drop_bounding_set(const std::vector<int>& keep) {
+    bool keep_all[64] = {};
+    for (int c : keep)
+        if (c >= 0 && c < 64) keep_all[c] = true;
+    long last = prctl(PR_CAPBSET_READ, 40, 0, 0, 0) >= 0 ? 40 : 37;
+    for (long cap = 0; cap <= last; cap++) {
+        if (keep_all[cap]) continue;
+        if (prctl(PR_CAPBSET_DROP, cap, 0, 0, 0) != 0 && errno != EINVAL)
+            die("PR_CAPBSET_DROP");
+    }
+    // Clear ambient capabilities wholesale.
+    prctl(PR_CAP_AMBIENT, PR_CAP_AMBIENT_CLEAR_ALL, 0, 0, 0);
+}
+
+// --- mounts ----------------------------------------------------------------
+
+static void bind_mount(const std::string& src, const std::string& dst,
+                       bool read_only, bool recursive) {
+    struct stat st;
+    if (stat(src.c_str(), &st) != 0) {
+        fprintf(stderr, "kukecell: bind src %s: %s\n", src.c_str(), strerror(errno));
+        _exit(125);
+    }
+    if (S_ISDIR(st.st_mode)) {
+        // mkdir -p dst
+        std::string acc;
+        for (size_t i = 1; i <= dst.size(); i++) {
+            if (i == dst.size() || dst[i] == '/') {
+                acc = dst.substr(0, i);
+                mkdir(acc.c_str(), 0755);
+            }
+        }
+    } else {
+        // Parent dirs + empty regular file as the bind target.
+        size_t slash = dst.rfind('/');
+        if (slash != std::string::npos) {
+            std::string parent = dst.substr(0, slash);
+            std::string acc;
+            for (size_t i = 1; i <= parent.size(); i++) {
+                if (i == parent.size() || parent[i] == '/') {
+                    acc = parent.substr(0, i);
+                    mkdir(acc.c_str(), 0755);
+                }
+            }
+        }
+        int fd = open(dst.c_str(), O_WRONLY | O_CREAT, 0644);
+        if (fd >= 0) close(fd);
+    }
+    unsigned long flags = MS_BIND | (recursive ? MS_REC : 0);
+    if (mount(src.c_str(), dst.c_str(), nullptr, flags, nullptr) != 0) {
+        fprintf(stderr, "kukecell: bind %s -> %s: %s\n", src.c_str(),
+                dst.c_str(), strerror(errno));
+        _exit(125);
+    }
+    if (read_only) {
+        if (mount(nullptr, dst.c_str(), nullptr,
+                  MS_REMOUNT | MS_BIND | MS_RDONLY | (recursive ? MS_REC : 0),
+                  nullptr) != 0)
+            die("remount ro");
+    }
+}
+
+struct BindSpec { std::string src, dst; bool ro; };
+
+// Overlayfs option values split on ':' and ','; image refs like name:tag
+// appear in store paths, so escape them (kernel accepts '\' escapes).
+static std::string overlay_escape(const std::string& p) {
+    std::string out;
+    for (char c : p) {
+        if (c == ':' || c == ',' || c == '\\') out += '\\';
+        out += c;
+    }
+    return out;
+}
+
+// Build a minimal /dev at <root>/dev: tmpfs + standard nodes bound from the
+// host + ONLY the granted --device nodes. This is the airtight chip
+// partitioning seam (reference: internal/ctr/devices.go:23-171 resolves and
+// injects explicit device nodes; everything else is simply absent).
+static void setup_dev(const std::string& root, const std::vector<std::string>& devices) {
+    std::string dev = root + "/dev";
+    // When masking the host's own /dev (host-rootfs cells), stash it first
+    // so node sources remain reachable under the new tmpfs.
+    std::string src_dev = "/dev";
+    bool stashed = false;
+    if (dev == "/dev") {
+        src_dev = "/tmp/.kukecell-olddev";
+        mkdir(src_dev.c_str(), 0700);
+        if (mount("/dev", src_dev.c_str(), nullptr, MS_BIND | MS_REC, nullptr) != 0)
+            die("stash /dev");
+        stashed = true;
+    }
+    mkdir(dev.c_str(), 0755);
+    if (mount("tmpfs", dev.c_str(), "tmpfs", MS_NOSUID,
+              "mode=755,size=65536k") != 0)
+        die("mount /dev tmpfs");
+    static const char* std_nodes[] = {"null", "zero", "full", "random",
+                                      "urandom", "tty"};
+    for (const char* n : std_nodes) {
+        std::string host = src_dev + "/" + n;
+        if (access(host.c_str(), F_OK) == 0)
+            bind_mount(host, dev + "/" + n, false, false);
+    }
+    for (const auto& d : devices) {
+        if (d.rfind("/dev/", 0) != 0) continue;
+        std::string host = src_dev + d.substr(4);  // src_dev + "/<node>"
+        if (access(host.c_str(), F_OK) == 0)
+            bind_mount(host, dev + "/" + d.substr(5), false, false);
+        else
+            fprintf(stderr, "kukecell: device %s not found, skipped\n", d.c_str());
+    }
+    if (stashed) {
+        umount2(src_dev.c_str(), MNT_DETACH);
+        rmdir(src_dev.c_str());
+    }
+    // pts with a private instance; ptmx via symlink.
+    std::string pts = dev + "/pts";
+    mkdir(pts.c_str(), 0755);
+    if (mount("devpts", pts.c_str(), "devpts", MS_NOSUID | MS_NOEXEC,
+              "newinstance,ptmxmode=0666,mode=0620") != 0)
+        die("mount devpts");
+    if (symlink("pts/ptmx", (dev + "/ptmx").c_str()) != 0 && errno != EEXIST)
+        die("symlink ptmx");
+    std::string shm = dev + "/shm";
+    mkdir(shm.c_str(), 0755);
+    if (mount("tmpfs", shm.c_str(), "tmpfs", MS_NOSUID | MS_NODEV,
+              "mode=1777,size=65536k") != 0)
+        die("mount /dev/shm");
+    symlink("/proc/self/fd", (dev + "/fd").c_str());
+    symlink("/proc/self/fd/0", (dev + "/stdin").c_str());
+    symlink("/proc/self/fd/1", (dev + "/stdout").c_str());
+    symlink("/proc/self/fd/2", (dev + "/stderr").c_str());
+}
+
+static void join_ns(pid_t pid, const char* name, int nstype) {
+    char path[64];
+    snprintf(path, sizeof(path), "/proc/%d/ns/%s", pid, name);
+    int fd = open(path, O_RDONLY);
+    if (fd < 0) {
+        fprintf(stderr, "kukecell: open %s: %s\n", path, strerror(errno));
+        _exit(125);
+    }
+    if (setns(fd, nstype) != 0) {
+        fprintf(stderr, "kukecell: setns %s: %s\n", path, strerror(errno));
+        _exit(125);
+    }
+    close(fd);
+}
+
+// --- sandbox mode ----------------------------------------------------------
+
+static int cmd_sandbox(int argc, char** argv) {
+    std::string pid_file, hostname, pause_bin;
+    bool host_net = false, host_pid = false;
+    for (int i = 0; i < argc; i++) {
+        std::string a = argv[i];
+        if (a == "--pid-file" && i + 1 < argc) pid_file = argv[++i];
+        else if (a == "--hostname" && i + 1 < argc) hostname = argv[++i];
+        else if (a == "--pause" && i + 1 < argc) pause_bin = argv[++i];
+        else if (a == "--host-net") host_net = true;
+        else if (a == "--host-pid") host_pid = true;
+        else { fprintf(stderr, "kukecell sandbox: unknown arg %s\n", a.c_str()); return 2; }
+    }
+    if (pid_file.empty() || pause_bin.empty()) {
+        fprintf(stderr, "kukecell sandbox: --pid-file and --pause required\n");
+        return 2;
+    }
+    int flags = CLONE_NEWUTS | CLONE_NEWIPC;
+    if (!host_net) flags |= CLONE_NEWNET;
+    if (!host_pid) flags |= CLONE_NEWPID;
+    if (unshare(flags) != 0) die("unshare");
+    // CLOEXEC pipe handshake: a successful exec closes it silently; an
+    // exec/setup failure writes the error message through it so the parent
+    // can report it and NOT publish a dead sandbox pid.
+    int pfd[2];
+    if (pipe2(pfd, O_CLOEXEC) != 0) die("pipe2");
+    pid_t child = fork();
+    if (child < 0) die("fork");
+    if (child == 0) {
+        // PID 1 of the sandbox (when NEWPID): kukepause reaps + fast-exits
+        // on TERM. Detach so the sandbox survives the caller.
+        close(pfd[0]);
+        setsid();
+        if (!hostname.empty())
+            if (sethostname(hostname.c_str(), hostname.size()) != 0) {
+                dprintf(pfd[1], "sethostname: %s", strerror(errno));
+                _exit(125);
+            }
+        int dn = open("/dev/null", O_RDWR);
+        if (dn >= 0) { dup2(dn, 0); dup2(dn, 1); dup2(dn, 2); if (dn > 2) close(dn); }
+        execl(pause_bin.c_str(), pause_bin.c_str(), (char*)nullptr);
+        dprintf(pfd[1], "exec %s: %s", pause_bin.c_str(), strerror(errno));
+        _exit(125);
+    }
+    close(pfd[1]);
+    char errbuf[256];
+    ssize_t n = read(pfd[0], errbuf, sizeof(errbuf) - 1);
+    close(pfd[0]);
+    if (n > 0) {
+        errbuf[n] = '\0';
+        fprintf(stderr, "kukecell: sandbox: %s\n", errbuf);
+        waitpid(child, nullptr, 0);
+        return 125;
+    }
+    write_file(pid_file, std::to_string(child));
+    return 0;
+}
+
+// --- enter mode ------------------------------------------------------------
+
+static pid_t g_workload = -1;
+static void forward_sig(int sig) {
+    if (g_workload > 0) kill(g_workload, sig);
+}
+
+static int cmd_enter(int argc, char** argv) {
+    pid_t sandbox = -1;
+    std::string rootfs, overlay_dir, workdir, user;
+    std::vector<BindSpec> binds;
+    std::vector<std::string> devices;
+    std::vector<std::string> cap_adds;
+    bool readonly_root = false, privileged = false;
+    bool host_net = false, host_pid = false, no_dev = false;
+    int i = 0;
+    for (; i < argc; i++) {
+        std::string a = argv[i];
+        if (a == "--sandbox" && i + 1 < argc) sandbox = atoi(argv[++i]);
+        else if (a == "--rootfs" && i + 1 < argc) rootfs = argv[++i];
+        else if (a == "--overlay-dir" && i + 1 < argc) overlay_dir = argv[++i];
+        else if (a == "--workdir" && i + 1 < argc) workdir = argv[++i];
+        else if (a == "--user" && i + 1 < argc) user = argv[++i];
+        else if (a == "--bind" && i + 1 < argc) {
+            std::string spec = argv[++i];
+            // SRC:DST[:ro] — strip the flag, then split at the LAST ':'
+            // (image-store paths legally contain ':' from name:tag refs;
+            // in-cell DSTs never do).
+            bool ro = false;
+            if (spec.size() > 3 && spec.substr(spec.size() - 3) == ":ro") {
+                ro = true;
+                spec = spec.substr(0, spec.size() - 3);
+            }
+            size_t sep = spec.rfind(':');
+            if (sep == std::string::npos) {
+                fprintf(stderr, "kukecell: bad --bind %s\n", argv[i]);
+                return 2;
+            }
+            binds.push_back({spec.substr(0, sep), spec.substr(sep + 1), ro});
+        }
+        else if (a == "--device" && i + 1 < argc) devices.push_back(argv[++i]);
+        else if (a == "--cap" && i + 1 < argc) cap_adds.push_back(argv[++i]);
+        else if (a == "--readonly-root") readonly_root = true;
+        else if (a == "--privileged") privileged = true;
+        else if (a == "--host-net") host_net = true;
+        else if (a == "--host-pid") host_pid = true;
+        else if (a == "--no-dev") no_dev = true;
+        else if (a == "--") { i++; break; }
+        else { fprintf(stderr, "kukecell enter: unknown arg %s\n", a.c_str()); return 2; }
+    }
+    if (i >= argc) { fprintf(stderr, "kukecell enter: no command\n"); return 2; }
+    if (sandbox <= 0) { fprintf(stderr, "kukecell enter: --sandbox required\n"); return 2; }
+
+    // Resolve cap names before any namespace surgery so errors are cheap.
+    std::vector<int> keep(std::begin(kDefaultCaps), std::end(kDefaultCaps));
+    for (const auto& name : cap_adds) {
+        int v = cap_lookup(name);
+        if (v < 0) {
+            fprintf(stderr, "kukecell: unknown capability %s\n", name.c_str());
+            return 2;
+        }
+        keep.push_back(v);
+    }
+
+    // 1. Join the sandbox's shared namespaces. PID membership applies to
+    //    children, hence the fork below.
+    if (!host_net) join_ns(sandbox, "net", CLONE_NEWNET);
+    join_ns(sandbox, "ipc", CLONE_NEWIPC);
+    join_ns(sandbox, "uts", CLONE_NEWUTS);
+    if (!host_pid) join_ns(sandbox, "pid", CLONE_NEWPID);
+
+    // 2. Private mount namespace; stop propagation to the host.
+    if (unshare(CLONE_NEWNS) != 0) die("unshare NEWNS");
+    if (mount(nullptr, "/", nullptr, MS_REC | MS_PRIVATE, nullptr) != 0)
+        die("make-rprivate /");
+
+    bool pivot = !rootfs.empty();
+    // Empty prefix for host-rootfs cells so path joins don't double the '/'.
+    std::string root = pivot ? rootfs : "";
+    if (pivot) {
+        if (!overlay_dir.empty()) {
+            // Copy-on-write view: the shared image rootfs is the (read-only)
+            // lower layer; this container's writes land in its own upper
+            // layer (the containerd-snapshotter analog).
+            std::string upper = overlay_dir + "/upper";
+            std::string work = overlay_dir + "/work";
+            std::string merged = overlay_dir + "/merged";
+            mkdir(overlay_dir.c_str(), 0755);
+            mkdir(upper.c_str(), 0755);
+            mkdir(work.c_str(), 0755);
+            mkdir(merged.c_str(), 0755);
+            std::string opts = "lowerdir=" + overlay_escape(rootfs) +
+                               ",upperdir=" + overlay_escape(upper) +
+                               ",workdir=" + overlay_escape(work);
+            if (mount("overlay", merged.c_str(), "overlay", 0, opts.c_str()) != 0)
+                die("mount overlay");
+            root = merged;
+        } else {
+            // Make the rootfs a mount point of its own (shared, writable —
+            // only used when the caller explicitly wants that).
+            if (mount(rootfs.c_str(), rootfs.c_str(), nullptr, MS_BIND | MS_REC,
+                      nullptr) != 0)
+                die("bind rootfs");
+        }
+        mkdir((root + "/proc").c_str(), 0555);
+        mkdir((root + "/tmp").c_str(), 01777);
+        chmod((root + "/tmp").c_str(), 01777);
+        mount("tmpfs", (root + "/tmp").c_str(), "tmpfs", MS_NOSUID, "mode=1777");
+        // Fresh private /run (binds under /run/kukeon land on it).
+        mkdir((root + "/run").c_str(), 0755);
+        mount("tmpfs", (root + "/run").c_str(), "tmpfs", MS_NOSUID, "mode=755");
+        mkdir((root + "/etc").c_str(), 0755);
+        // Name resolution / identity files from the host (the runner will
+        // switch these to per-cell files once cell DNS exists).
+        for (const char* f : {"/etc/resolv.conf", "/etc/hosts"})
+            if (access(f, F_OK) == 0)
+                bind_mount(f, root + f, true, false);
+    } else if (!privileged) {
+        // Host-rootfs cell: private /run/kukeon so secret binds never
+        // create droppings on the real host filesystem.
+        mkdir("/run/kukeon", 0755);
+        mount("tmpfs", "/run/kukeon", "tmpfs", MS_NOSUID, "mode=755");
+    }
+    // Fresh sysfs bound to the joined net namespace (a stale host /sys
+    // would leak the host's interface list through /sys/class/net).
+    if (pivot) {
+        mkdir((root + "/sys").c_str(), 0555);
+        if (mount("sysfs", (root + "/sys").c_str(), "sysfs",
+                  MS_NOSUID | MS_NOEXEC | MS_NODEV | (privileged ? 0 : MS_RDONLY),
+                  nullptr) != 0)
+            die("mount /sys");
+    } else if (!host_net) {
+        mount("sysfs", "/sys", "sysfs",
+              MS_NOSUID | MS_NOEXEC | MS_NODEV | (privileged ? 0 : MS_RDONLY),
+              nullptr);
+    }
+    if (!no_dev && !privileged)
+        setup_dev(root, devices);
+    for (const auto& b : binds)
+        bind_mount(b.src, pivot ? root + b.dst : b.dst, b.ro, true);
+
+    if (pivot) {
+        if (chdir(root.c_str()) != 0) die("chdir rootfs");
+        // pivot_root(".", ".") stacks old root under new; detach it after.
+        if (syscall(SYS_pivot_root, ".", ".") != 0) die("pivot_root");
+        if (umount2(".", MNT_DETACH) != 0) die("umount old root");
+        if (chdir("/") != 0) die("chdir /");
+    }
+    if (readonly_root && !privileged) {
+        if (mount(nullptr, "/", nullptr, MS_REMOUNT | MS_BIND | MS_RDONLY,
+                  nullptr) != 0 && pivot)
+            die("remount / ro");
+    }
+
+    // 3. Fork so the workload is inside the joined PID namespace; mount a
+    //    matching /proc there.
+    pid_t child = fork();
+    if (child < 0) die("fork");
+    if (child == 0) {
+        if (!host_pid || pivot) {
+            // Fresh procfs for the (possibly joined) pid namespace.
+            if (mount("proc", "/proc", "proc",
+                      MS_NOSUID | MS_NOEXEC | MS_NODEV, nullptr) != 0 && pivot)
+                die("mount /proc");
+        }
+        if (!privileged) {
+            drop_bounding_set(keep);
+            if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0)
+                die("no_new_privs");
+        }
+        if (!user.empty()) {
+            uid_t uid = atoi(user.c_str());
+            gid_t gid = uid;
+            size_t sep = user.find(':');
+            if (sep != std::string::npos) gid = atoi(user.c_str() + sep + 1);
+            if (setgroups(0, nullptr) != 0) die("setgroups");
+            if (setgid(gid) != 0) die("setgid");
+            if (setuid(uid) != 0) die("setuid");
+        }
+        if (!workdir.empty()) {
+            // Builders commonly WORKDIR a dir no instruction made; create
+            // it (in the writable overlay) like the OCI runtimes do.
+            std::string acc;
+            for (size_t n = 1; n <= workdir.size(); n++) {
+                if (n == workdir.size() || workdir[n] == '/') {
+                    acc = workdir.substr(0, n);
+                    mkdir(acc.c_str(), 0755);
+                }
+            }
+            if (chdir(workdir.c_str()) != 0) {
+                fprintf(stderr, "kukecell: chdir %s: %s\n", workdir.c_str(),
+                        strerror(errno));
+                _exit(126);
+            }
+        }
+        execvp(argv[i], &argv[i]);
+        fprintf(stderr, "kukecell: exec %s: %s\n", argv[i], strerror(errno));
+        _exit(127);
+    }
+
+    g_workload = child;
+    struct sigaction sa = {};
+    sa.sa_handler = forward_sig;
+    sigaction(SIGTERM, &sa, nullptr);
+    sigaction(SIGINT, &sa, nullptr);
+
+    int status = 0;
+    while (waitpid(child, &status, 0) < 0)
+        if (errno != EINTR) { status = 0; break; }
+    return WIFEXITED(status) ? WEXITSTATUS(status)
+         : WIFSIGNALED(status) ? 128 + WTERMSIG(status) : 1;
+}
+
+int main(int argc, char** argv) {
+    if (argc < 2) {
+        fprintf(stderr, "usage: kukecell sandbox|enter ...\n");
+        return 2;
+    }
+    std::string mode = argv[1];
+    if (mode == "sandbox") return cmd_sandbox(argc - 2, argv + 2);
+    if (mode == "enter") return cmd_enter(argc - 2, argv + 2);
+    fprintf(stderr, "kukecell: unknown mode %s\n", mode.c_str());
+    return 2;
+}

@@ -1,0 +1,299 @@
+"""Black-box e2e for the namespace isolation layer (VERDICT r1 item 2).
+
+Proves — through the real daemon + CLI, no fakes — that cells are NOT bare
+host processes: they live in their own PID/UTS/NET/mount namespaces, see an
+image rootfs as '/', get a minimal /dev, and honor the security spec
+(readOnlyRootFilesystem, capabilities). Reference behaviors:
+internal/ctr/spec.go:309-511 (OCI security/mounts/devices),
+cmd/kukepause/main.go (in-sandbox PID 1).
+
+Root-gated: skipped unless the host can create namespaces.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import time
+
+import pytest
+
+from kukeon_tpu.runtime.cells import namespace as nsb
+
+from tests.test_runtime_e2e import Daemon  # reuse the daemon harness
+
+pytestmark = pytest.mark.skipif(
+    not (os.geteuid() == 0 and os.access(nsb.KUKECELL, os.X_OK)),
+    reason="namespace isolation needs root + kukecell",
+)
+
+
+@pytest.fixture
+def daemon():
+    d = Daemon()
+    yield d
+    d.stop()
+
+
+def _apply(daemon, manifest: str):
+    daemon.kuke("apply", "-f", "-", stdin_data=manifest)
+
+
+def _wait_exit(daemon, cell: str, timeout: float = 15.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        p = daemon.kuke("get", "cell", cell, check=False)
+        if "exited" in p.stdout or "stopped" in p.stdout.lower():
+            return
+        time.sleep(0.2)
+
+
+def _log(daemon, cell: str) -> str:
+    return daemon.kuke("log", cell).stdout
+
+
+CHECK_MANIFEST = """
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {{name: {name}}}
+spec:
+  containers:
+    - name: main
+      command: ["sh", "-c", {cmd!r}]
+      restartPolicy: {{policy: never}}
+"""
+
+
+class TestHostRootfsIsolation:
+    """Cells without an image keep the host filesystem but still get
+    PID/UTS/NET/mount/dev isolation."""
+
+    def test_uts_pid_net_dev(self, daemon):
+        cmd = (
+            "echo HOST=$(hostname);"
+            "echo PROCS=$(ls /proc | grep -c '^[0-9]*$');"
+            "echo COMM1=$(cat /proc/1/comm);"
+            "echo NETLINKS=$(ls /sys/class/net | tr '\\n' ',');"
+            "echo DEVNODES=$(ls /dev | tr '\\n' ',')"
+        )
+        _apply(daemon, CHECK_MANIFEST.format(name="isoprobe", cmd=cmd))
+        _wait_exit(daemon, "isoprobe")
+        log = _log(daemon, "isoprobe")
+        assert "HOST=isoprobe" in log            # UTS: hostname = cell name
+        assert "COMM1=kukepause" in log          # PID: kukepause is PID 1
+        # PID ns: only kukepause + the probe shell (+ children) visible.
+        procs = int(log.split("PROCS=")[1].split()[0])
+        assert procs < 6
+        # NET ns: loopback only (veth attach is a separate milestone).
+        netlinks = log.split("NETLINKS=")[1].split()[0]
+        assert netlinks.strip(",") == "lo"
+        # /dev is masked: standard nodes only, no host block devices.
+        devnodes = log.split("DEVNODES=")[1].split()[0]
+        assert "null" in devnodes and "loop0" not in devnodes
+
+    def test_default_caps_deny_mount(self, daemon):
+        cmd = (
+            "grep CapBnd /proc/self/status;"
+            "mount -t tmpfs none /mnt 2>&1 || echo MOUNT_DENIED"
+        )
+        _apply(daemon, CHECK_MANIFEST.format(name="capprobe", cmd=cmd))
+        _wait_exit(daemon, "capprobe")
+        log = _log(daemon, "capprobe")
+        assert "CapBnd:\t00000000a80425fb" in log  # docker default bounded set
+        assert "MOUNT_DENIED" in log
+
+    def test_added_capability(self, daemon):
+        manifest = """
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {name: capadd}
+spec:
+  containers:
+    - name: main
+      command: ["sh", "-c", "grep CapBnd /proc/self/status"]
+      capabilities: [NET_ADMIN]
+      restartPolicy: {policy: never}
+"""
+        _apply(daemon, manifest)
+        _wait_exit(daemon, "capadd")
+        log = _log(daemon, "capadd")
+        # a80425fb | 1<<12 (NET_ADMIN) = a80435fb
+        assert "CapBnd:\t00000000a80435fb" in log
+
+    def test_sandbox_lifecycle(self, daemon):
+        _apply(daemon, CHECK_MANIFEST.format(name="sbox", cmd="sleep 30"))
+        time.sleep(1.0)
+        # Find the sandbox pid through the run path.
+        matches = []
+        for root, _dirs, files in os.walk(daemon.run_path):
+            if "sandbox.pid" in files and "/sbox" in root:
+                matches.append(os.path.join(root, "sandbox.pid"))
+        assert matches, "sandbox.pid not created"
+        pid = int(open(matches[0]).read())
+        assert os.path.exists(f"/proc/{pid}")
+        with open(f"/proc/{pid}/comm") as f:
+            assert f.read().strip() == "kukepause"
+        daemon.kuke("stop", "sbox")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and os.path.exists(f"/proc/{pid}"):
+            time.sleep(0.05)
+        assert not os.path.exists(f"/proc/{pid}"), "sandbox survived stop"
+        assert not os.path.exists(matches[0]), "sandbox.pid not cleaned up"
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="needs g++")
+class TestImageRootfsIsolation:
+    """Image-backed cells see the image rootfs as '/' via pivot_root."""
+
+    CHECKER_SRC = r"""
+#include <stdio.h>
+#include <dirent.h>
+#include <unistd.h>
+int main() {
+    FILE* f = fopen("/marker.txt", "r");
+    printf("MARKER=%s\n", f ? "present" : "missing");
+    if (f) fclose(f);
+    printf("HOSTETC=%s\n", access("/etc/passwd", F_OK) == 0 ? "visible" : "hidden");
+    DIR* d = opendir("/");
+    int n = 0; struct dirent* e;
+    while ((e = readdir(d))) n++;
+    printf("ROOTENTRIES=%d\n", n);
+    FILE* w = fopen("/write-probe", "w");
+    printf("ROOTWRITE=%s\n", w ? "ok" : "denied");
+    if (w) fclose(w);
+    return 0;
+}
+"""
+
+    @pytest.fixture
+    def image(self, daemon, tmp_path):
+        src = tmp_path / "checker.c"
+        src.write_text(self.CHECKER_SRC)
+        out = tmp_path / "checker"
+        subprocess.run(
+            ["g++", "-static", "-O1", "-o", str(out), str(src)], check=True
+        )
+        (tmp_path / "marker.txt").write_text("hello from image\n")
+        (tmp_path / "Kukefile").write_text(
+            "FROM scratch\nCOPY checker /checker\nCOPY marker.txt /marker.txt\n"
+            "ENTRYPOINT [\"/checker\"]\n"
+        )
+        daemon.kuke("build", "-t", "isochk:v1", str(tmp_path))
+        return "isochk:v1"
+
+    def test_pivot_root(self, daemon, image):
+        manifest = f"""
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {{name: imgiso}}
+spec:
+  containers:
+    - name: main
+      image: {image}
+      restartPolicy: {{policy: never}}
+"""
+        _apply(daemon, manifest)
+        _wait_exit(daemon, "imgiso")
+        log = _log(daemon, "imgiso")
+        assert "MARKER=present" in log      # image content at its real path
+        assert "HOSTETC=hidden" in log      # host filesystem NOT visible
+        # /: checker, marker.txt, dev, proc, tmp, etc, . , .. and little else
+        entries = int(log.split("ROOTENTRIES=")[1].split()[0])
+        assert entries < 12
+        assert "ROOTWRITE=ok" in log        # rw rootfs by default
+
+    def test_readonly_rootfs(self, daemon, image):
+        manifest = f"""
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {{name: imgro}}
+spec:
+  containers:
+    - name: main
+      image: {image}
+      readOnlyRootFilesystem: true
+      restartPolicy: {{policy: never}}
+"""
+        _apply(daemon, manifest)
+        _wait_exit(daemon, "imgro")
+        assert "ROOTWRITE=denied" in _log(daemon, "imgro")
+
+
+class TestSecretsAndVolumes:
+    def test_secret_bind_in_cell_path(self, daemon):
+        manifest = """
+apiVersion: kukeon.io/v1beta1
+kind: Secret
+metadata: {name: api-key}
+spec: {data: {TOKEN: sekrit}}
+---
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {name: secprobe}
+spec:
+  containers:
+    - name: main
+      command: ["sh", "-c", "cat /run/kukeon/secrets/api-key.env; \
+touch /run/kukeon/secrets/api-key.env 2>&1 || echo SECRET_RO"]
+      secrets: [{name: api-key}]
+      restartPolicy: {policy: never}
+"""
+        _apply(daemon, manifest)
+        _wait_exit(daemon, "secprobe")
+        log = _log(daemon, "secprobe")
+        assert "TOKEN=sekrit" in log
+        assert "SECRET_RO" in log
+        # The secret must NOT exist at that path on the host.
+        assert not os.path.exists("/run/kukeon/secrets/api-key.env")
+
+    def test_volume_bind_mount(self, daemon, tmp_path):
+        manifest = """
+apiVersion: kukeon.io/v1beta1
+kind: Volume
+metadata: {name: scratch}
+spec: {reclaimPolicy: delete}
+---
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {name: volprobe}
+spec:
+  containers:
+    - name: main
+      command: ["sh", "-c", "echo persisted > /data/out.txt && echo WROTE"]
+      volumes: [{name: scratch, path: /data}]
+      restartPolicy: {policy: never}
+"""
+        _apply(daemon, manifest)
+        _wait_exit(daemon, "volprobe")
+        assert "WROTE" in _log(daemon, "volprobe")
+        # Data landed in the volume's host data dir.
+        found = []
+        for root, _dirs, files in os.walk(daemon.run_path):
+            if "out.txt" in files:
+                found.append(os.path.join(root, "out.txt"))
+        assert found and open(found[0]).read().strip() == "persisted"
+        # No data leaked to a host-side /data (the bind target may exist as
+        # an empty dir on host-rootfs cells; its content must not).
+        assert not os.path.exists("/data/out.txt")
+
+    def test_readonly_volume(self, daemon):
+        manifest = """
+apiVersion: kukeon.io/v1beta1
+kind: Volume
+metadata: {name: rodata}
+spec: {reclaimPolicy: delete}
+---
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {name: roprobe}
+spec:
+  containers:
+    - name: main
+      command: ["sh", "-c", "touch /rodata/x 2>&1 || echo VOLUME_RO"]
+      volumes: [{name: rodata, path: /rodata, readOnly: true}]
+      restartPolicy: {policy: never}
+"""
+        _apply(daemon, manifest)
+        _wait_exit(daemon, "roprobe")
+        assert "VOLUME_RO" in _log(daemon, "roprobe")
