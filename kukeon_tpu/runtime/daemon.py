@@ -420,12 +420,14 @@ class DaemonServer:
             except (OSError, PermissionError):
                 pass  # non-root daemon: group access simply stays off
 
-        # Boot heal: reboots flush iptables/bridges; re-assert the FORWARD
-        # admission chain + every space network before serving (reference:
-        # server.go:151-196, 307).
+        # Boot heal: reboots flush iptables/bridges; re-assert every space
+        # network, then the FORWARD admission chain (reference:
+        # server.go:151-196, 307). Order matters for the kukenet driver:
+        # the full-space pass must prime its whole-table state before any
+        # commit, or a restart would wipe live deny chains.
+        self.ctl.reconcile_space_networks()
         if self.ctl.runner.netman is not None:
             self.ctl.runner.netman.install_forward()
-        self.ctl.reconcile_space_networks()
         # Eager reconcile pass: a host restart converges immediately
         # (reference: server.go:226-244).
         self.ctl.reconcile_cells()

@@ -54,6 +54,7 @@ class CellStatus:
     containers: list[ContainerStatus] = field(default_factory=list)
     observed_generation: int = 0
     tpu_chips: list[int] = field(default_factory=list)   # chips granted
+    ip: str | None = None                # cell IP on the space bridge
     # OutOfSync detection for Config-lineage cells (reference:
     # internal/controller/reconcile_outofsync.go:38-160). out_of_sync_error
     # marks an UNDECIDABLE verdict (blueprint missing, materialize failure)

@@ -1,0 +1,173 @@
+"""kukenet driver: whole-table netfilter programming without iptables.
+
+Many minimal hosts compile the iptables kernel side in (xt_conntrack,
+xt_comment, xt_tcpudp, ...) but ship no userspace tool. The native
+``kukenet`` binary speaks the xtables ABI directly (IPT_SO_SET_REPLACE);
+this module renders the COMPLETE desired filter table — forward admission
+(firewall.py) + every space's egress chain (netpolicy.py) — into kukenet's
+line protocol and commits it atomically, preserving the reference's
+fail-closed property (enforcer.go:34-232 via iptables-restore --noflush:
+a default-deny chain never exists without its terminal DROP).
+
+Table layout mirrors the reference:
+
+  FORWARD:       -j KUKEON-EGRESS   (egress policy first)
+                 -j KUKEON-FORWARD  (admission for return/external traffic)
+  KUKEON-EGRESS: per-space dispatch by bridge interface
+  KUKEON-EGRESS-<realm>-<space>: established + allows + terminal verdict
+  KUKEON-FORWARD: established + external-ingress admission
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+
+from kukeon_tpu.runtime.net.firewall import FORWARD_CHAIN
+from kukeon_tpu.runtime.net.bridge import BRIDGE_PREFIX
+from kukeon_tpu.runtime.net.netpolicy import MASTER_CHAIN, Enforcer, Policy
+
+log = logging.getLogger("kukeon.net")
+
+_BIN_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bin"
+)
+KUKENET = os.path.join(_BIN_DIR, "kukenet")
+
+BRIDGE_WILDCARD = BRIDGE_PREFIX + "+"
+
+
+def kukenet_usable(path: str = KUKENET) -> bool:
+    """True when the kernel xtables ABI answers and we may program it."""
+    if not os.access(path, os.X_OK) or os.geteuid() != 0:
+        return False
+    try:
+        return subprocess.run([path, "check"], capture_output=True,
+                              timeout=5).returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def render_table(policies: list[Policy]) -> str:
+    """Full filter-table spec (kukenet line protocol) for these policies."""
+    lines = [
+        "policy INPUT ACCEPT",
+        "policy FORWARD ACCEPT",
+        "policy OUTPUT ACCEPT",
+        f"chain {FORWARD_CHAIN}",
+        f"chain {MASTER_CHAIN}",
+    ]
+    for p in policies:
+        lines.append(f"chain {p.chain_name()}")
+    # FORWARD hook: egress policy first, then admission.
+    lines.append(f"rule chain=FORWARD verdict={MASTER_CHAIN}")
+    lines.append(f"rule chain=FORWARD verdict={FORWARD_CHAIN}")
+    # Admission chain (firewall.py semantics).
+    lines.append(
+        f"rule chain={FORWARD_CHAIN} state=EST_REL verdict=ACCEPT "
+        "comment=kukeon-forward:established"
+    )
+    lines.append(
+        f"rule chain={FORWARD_CHAIN} in=!{BRIDGE_WILDCARD} "
+        f"out={BRIDGE_WILDCARD} verdict=ACCEPT comment=kukeon-forward:ingress"
+    )
+    # Per-space dispatch + chains.
+    for p in policies:
+        lines.append(
+            f"rule chain={MASTER_CHAIN} in={p.bridge} "
+            f"verdict={p.chain_name()} comment={p.comment_tag()}:dispatch"
+        )
+    for p in policies:
+        chain = p.chain_name()
+        tag = p.comment_tag()
+        lines.append(
+            f"rule chain={chain} state=EST_REL verdict=ACCEPT "
+            f"comment={tag}:established"
+        )
+        for i, r in enumerate(p.allow):
+            targets = [r.cidr] if r.cidr else [f"{ip}/32" for ip in r.ips]
+            label = (f"allow[{i}]:host={r.original_host}" if r.original_host
+                     else f"allow[{i}]:cidr={r.cidr}")
+            for dst in targets:
+                if r.ports:
+                    for port in r.ports:
+                        lines.append(
+                            f"rule chain={chain} dst={dst} proto=tcp "
+                            f"dport={port} verdict=ACCEPT comment={tag}:{label}"
+                        )
+                else:
+                    lines.append(
+                        f"rule chain={chain} dst={dst} verdict=ACCEPT "
+                        f"comment={tag}:{label}"
+                    )
+        terminal = "DROP" if p.default == "deny" else "ACCEPT"
+        lines.append(
+            f"rule chain={chain} verdict={terminal} comment={tag}:default"
+        )
+    return "\n".join(lines) + "\n"
+
+
+class KukenetEnforcer(Enforcer):
+    """Stateful whole-table enforcer: tracks the desired policy per space
+    and re-commits the complete table on every change/reconcile tick."""
+
+    def __init__(self, kukenet: str = KUKENET):
+        self.kukenet = kukenet
+        self.policies: dict[str, Policy] = {}   # chain name -> policy
+        self._batching = False
+        # Whole-table replace + in-memory desired state means a freshly
+        # restarted daemon must NOT commit before it has re-collected every
+        # space's policy — doing so would wipe live deny chains (fail-open).
+        # The kernel keeps the previous run's table until the first complete
+        # reconcile pass primes us.
+        self._primed = False
+
+    def available(self) -> bool:
+        return kukenet_usable(self.kukenet)
+
+    def _commit(self) -> None:
+        if self._batching or not self._primed:
+            return
+        spec = render_table(list(self.policies.values()))
+        res = subprocess.run([self.kukenet, "apply"], input=spec,
+                             capture_output=True, text=True)
+        if res.returncode != 0:
+            log.error("kukenet apply failed (%d): %s",
+                      res.returncode, res.stderr.strip())
+
+    def begin_batch(self) -> None:
+        self._batching = True
+
+    def end_batch(self, complete: bool) -> None:
+        """Commit the batch. ``complete=True`` asserts every space was
+        collected, which arms commits for good; an incomplete pass keeps
+        the previous kernel table (stale-but-closed beats open)."""
+        self._batching = False
+        if complete:
+            self._primed = True
+            self._commit()
+        elif self._primed:
+            # Already primed: the in-memory set is still the full desired
+            # state (the failed space keeps its last good policy entry).
+            self._commit()
+        else:
+            log.warning("kukenet: incomplete first reconcile; keeping the "
+                        "previous kernel table")
+
+    def apply(self, p: Policy) -> None:
+        self.policies[p.chain_name()] = p
+        self._commit()
+
+    def remove(self, p: Policy) -> None:
+        self.policies.pop(p.chain_name(), None)
+        self._commit()
+
+    def install_admission(self) -> None:
+        """Admission rules ride every commit; just assert the base table."""
+        self._commit()
+
+    def dump(self) -> str:
+        res = subprocess.run([self.kukenet, "dump"], capture_output=True,
+                             text=True)
+        return res.stdout

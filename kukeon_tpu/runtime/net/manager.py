@@ -28,18 +28,21 @@ from kukeon_tpu.runtime.net.netpolicy import (
 )
 from kukeon_tpu.runtime.net.runners import CommandRunner, ShellRunner
 from kukeon_tpu.runtime.net.slice import discover_slice, slice_mesh_rules
-from kukeon_tpu.runtime.net.subnet import SubnetAllocator
+from kukeon_tpu.runtime.net.subnet import SubnetAllocator, gateway_ip
+from kukeon_tpu.runtime.net.veth import IPAllocator, VethManager, host_ifname
 from kukeon_tpu.runtime.store import ResourceStore
 
 
 def _enforcement_enabled(runner: CommandRunner) -> bool:
+    from kukeon_tpu.runtime.net.kukenet import kukenet_usable
+
     override = os.environ.get("KUKEON_NET_ENFORCE")
     if override is not None:
         return override not in ("0", "false", "")
     return (
         os.geteuid() == 0
         and runner.available("ip")
-        and runner.available("iptables")
+        and (runner.available("iptables") or kukenet_usable())
     )
 
 
@@ -55,17 +58,64 @@ class NetworkManager:
         )
         self.enforcing = _enforcement_enabled(self.runner)
         self.bridges = BridgeManager(self.runner)
-        self.enforcer = (IptablesEnforcer(self.runner) if self.enforcing
-                         else NoopEnforcer())
+        # Enforcer preference: the iptables CLI when present (interops with
+        # other tools' rules), else the native kukenet whole-table driver.
+        from kukeon_tpu.runtime.net.kukenet import KukenetEnforcer, kukenet_usable
+
+        if self.enforcing and self.runner.available("iptables"):
+            self.enforcer = IptablesEnforcer(self.runner)
+        elif self.enforcing and kukenet_usable():
+            self.enforcer = KukenetEnforcer()
+        else:
+            self.enforcer = NoopEnforcer()
         self.forward = ForwardInstaller(self.runner)
         self.resolver = resolver
         self.slice_topology = discover_slice()
+        self.veth = VethManager(self.runner)
+        self.ipam = IPAllocator(store)
 
     # --- bootstrap ----------------------------------------------------------
 
     def install_forward(self) -> None:
-        if self.enforcing:
+        if not self.enforcing:
+            return
+        from kukeon_tpu.runtime.net.kukenet import KukenetEnforcer
+
+        if isinstance(self.enforcer, KukenetEnforcer):
+            self.enforcer.install_admission()   # rides the whole-table commit
+        else:
             self.forward.install()
+        # Routed cell traffic needs forwarding on (the CNI bridge plugin
+        # does the same).
+        try:
+            with open("/proc/sys/net/ipv4/ip_forward", "w") as f:
+                f.write("1")
+        except OSError:
+            pass
+
+    # --- per-cell -----------------------------------------------------------
+
+    def attach_cell(self, realm: str, space: str, owner: str,
+                    sandbox_pid: int) -> str | None:
+        """Join a cell sandbox's netns to its space bridge; returns the cell
+        IP (persisted per space; stable across restarts)."""
+        if not self.enforcing:
+            return None
+        subnet = self.subnets.allocate(realm, space)
+        bridge = self.bridges.ensure(realm, space, subnet)
+        ip = self.ipam.allocate(realm, space, subnet, owner)
+        prefix = subnet.split("/")[1]
+        self.veth.attach(
+            sandbox_pid, bridge, host_ifname(owner),
+            f"{ip}/{prefix}", gateway_ip(subnet),
+        )
+        return ip
+
+    def detach_cell(self, realm: str, space: str, owner: str) -> None:
+        if not self.enforcing:
+            return
+        self.veth.detach(host_ifname(owner))
+        self.ipam.release(realm, space, owner)
 
     # --- per-space ----------------------------------------------------------
 
@@ -112,17 +162,31 @@ class NetworkManager:
         return from_wire(t.SpaceSpec, rec.spec_json or {})
 
     def reconcile_all(self) -> dict[str, dict]:
-        """Re-assert every space's subnet/conflist/bridge/egress chain."""
+        """Re-assert every space's subnet/conflist/bridge/egress chain.
+
+        The whole-table kukenet driver commits once per pass, and only
+        primes (arms commits after a daemon restart) when the pass covered
+        every space — an incomplete first pass must keep the previous
+        kernel table rather than wipe not-yet-collected deny chains."""
         out: dict[str, dict] = {}
-        for realm in self.store.list_realms():
-            for space in self.store.list_spaces(realm):
-                try:
-                    spec = self.space_spec(realm, space)
-                    out[f"{realm}/{space}"] = self.ensure_space_network(
-                        realm, space, spec
-                    )
-                except Exception as e:  # noqa: BLE001 — one bad space must not stall the tick
-                    out[f"{realm}/{space}"] = {"error": f"{type(e).__name__}: {e}"}
+        batched = hasattr(self.enforcer, "begin_batch")
+        if batched:
+            self.enforcer.begin_batch()
+        complete = True
+        try:
+            for realm in self.store.list_realms():
+                for space in self.store.list_spaces(realm):
+                    try:
+                        spec = self.space_spec(realm, space)
+                        out[f"{realm}/{space}"] = self.ensure_space_network(
+                            realm, space, spec
+                        )
+                    except Exception as e:  # noqa: BLE001 — one bad space must not stall the tick
+                        complete = False
+                        out[f"{realm}/{space}"] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            if batched:
+                self.enforcer.end_batch(complete)
         return out
 
 

@@ -1,0 +1,135 @@
+"""Per-cell network attachment: veth pair into the space bridge + IPAM.
+
+The reference does CNI ADD/DEL per cell against the bridge/host-local
+plugins (internal/cni/container.go; release-before-recreate ordering
+start.go:310-348). Here the runner owns it natively: the cell sandbox's
+netns (kukecell) gets one end of a veth pair renamed to eth0 with an IP
+from the space's subnet; the host end joins the space bridge. IP
+assignments persist per space (host-local-IPAM analog) and survive daemon
+restarts; the veth dies with the sandbox's netns automatically, so crash
+cleanup is structural rather than scripted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import ipaddress
+import logging
+
+from kukeon_tpu.runtime.errors import FailedPrecondition
+from kukeon_tpu.runtime.net.runners import CommandRunner
+from kukeon_tpu.runtime.net.subnet import gateway_ip
+from kukeon_tpu.runtime.store import ResourceStore
+
+log = logging.getLogger("kukeon.net")
+
+IPAM_FILE = "ipam.json"
+# 'kv-' prefix: deliberately NOT 'k-' so the per-space egress dispatch
+# (matching in=k-<bridge>) and the admission wildcard never confuse a cell
+# veth for a bridge.
+VETH_PREFIX = "kv-"
+
+
+def host_ifname(owner: str) -> str:
+    """Deterministic IFNAMSIZ-safe host-side veth name for a cell."""
+    return VETH_PREFIX + hashlib.sha256(owner.encode()).hexdigest()[:10]
+
+
+class IPAllocator:
+    """Per-space IP assignment, persisted under the space dir."""
+
+    def __init__(self, store: ResourceStore):
+        self.store = store
+
+    def _state_parts(self, realm: str, space: str):
+        return (*self.store.space_parts(realm, space), IPAM_FILE)
+
+    def allocate(self, realm: str, space: str, subnet: str, owner: str) -> str:
+        with self.store.ms.lock():
+            state = self.store.ms.read_json_or({}, *self._state_parts(realm, space))
+            for ip, o in state.items():
+                if o == owner:
+                    return ip
+            net = ipaddress.ip_network(subnet)
+            gw = gateway_ip(subnet)
+            for host in net.hosts():
+                ip = str(host)
+                if ip == gw or ip in state:
+                    continue
+                state[ip] = owner
+                self.store.ms.write_json(state, *self._state_parts(realm, space))
+                return ip
+        raise FailedPrecondition(f"subnet {subnet} exhausted in {realm}/{space}")
+
+    def release(self, realm: str, space: str, owner: str) -> None:
+        with self.store.ms.lock():
+            state = self.store.ms.read_json_or({}, *self._state_parts(realm, space))
+            remaining = {ip: o for ip, o in state.items() if o != owner}
+            if len(remaining) != len(state):
+                self.store.ms.write_json(remaining, *self._state_parts(realm, space))
+
+    def lookup(self, realm: str, space: str, owner: str) -> str | None:
+        state = self.store.ms.read_json_or({}, *self._state_parts(realm, space))
+        for ip, o in state.items():
+            if o == owner:
+                return ip
+        return None
+
+
+class VethManager:
+    """Create/destroy the veth pair joining a sandbox netns to a bridge."""
+
+    def __init__(self, runner: CommandRunner):
+        self.runner = runner
+
+    def _ns(self, pid: int, *cmd: str) -> tuple[int, str]:
+        return self.runner.run(["nsenter", "-t", str(pid), "-n", *cmd])
+
+    def attached(self, host_if: str) -> bool:
+        code, _ = self.runner.run(["ip", "link", "show", host_if])
+        return code == 0
+
+    def attach(self, sandbox_pid: int, bridge: str, host_if: str,
+               ip_cidr: str, gateway: str) -> None:
+        """Idempotent: an existing host_if means the attachment (and the
+        sandbox holding its peer) survived a daemon restart."""
+        if self.attached(host_if):
+            return
+        peer = host_if + "c"
+        code, out = self.runner.run(
+            ["ip", "link", "add", host_if, "type", "veth", "peer",
+             "name", peer]
+        )
+        if code != 0:
+            raise FailedPrecondition(f"veth create failed: {out.strip()}")
+        steps = [
+            ["ip", "link", "set", peer, "netns", str(sandbox_pid)],
+            ["ip", "link", "set", host_if, "master", bridge],
+            ["ip", "link", "set", host_if, "up"],
+        ]
+        for argv in steps:
+            code, out = self.runner.run(argv)
+            if code != 0:
+                self.detach(host_if)
+                raise FailedPrecondition(
+                    f"{' '.join(argv)} failed: {out.strip()}"
+                )
+        ns_steps = [
+            ("ip", "link", "set", "lo", "up"),
+            ("ip", "link", "set", peer, "name", "eth0"),
+            ("ip", "addr", "add", ip_cidr, "dev", "eth0"),
+            ("ip", "link", "set", "eth0", "up"),
+            ("ip", "route", "add", "default", "via", gateway),
+        ]
+        for argv in ns_steps:
+            code, out = self._ns(sandbox_pid, *argv)
+            if code != 0:
+                self.detach(host_if)
+                raise FailedPrecondition(
+                    f"in-netns {' '.join(argv)} failed: {out.strip()}"
+                )
+
+    def detach(self, host_if: str) -> None:
+        """Best-effort: the veth vanishes with the netns anyway."""
+        if self.attached(host_if):
+            self.runner.run(["ip", "link", "del", host_if])

@@ -209,6 +209,7 @@ class Runner:
         if total_chips:
             chips = self.devices.allocate(self._owner_key(rec), total_chips)
         rec.status.tpu_chips = chips
+        self._ensure_cell_network(rec)
 
         slices = self._chip_slices(containers, chips)
         new_statuses = []
@@ -253,6 +254,28 @@ class Runner:
         return self.store.ms.ensure_dir(
             *self.store.cell_parts(rec.realm, rec.space, rec.stack, rec.name)
         )
+
+    def _ensure_cell_network(self, rec: model.CellRecord) -> None:
+        """Attach the cell's sandbox netns to its space bridge (idempotent;
+        reference: CNI ADD on cell start, runner/start.go:474-560)."""
+        if not self.backend.isolated or self.netman is None:
+            return
+        containers = self.cell_containers(rec)
+        if containers and all(c.host_network for c in containers):
+            # Nothing will use the sandbox netns; don't burn a bridge IP or
+            # publish an address nothing listens on.
+            return
+        try:
+            pid = self.backend.ensure_sandbox(self._cell_dir(rec), rec.name)
+            rec.status.ip = self.netman.attach_cell(
+                rec.realm, rec.space, self._owner_key(rec), pid
+            )
+        except Exception as e:  # noqa: BLE001 — cells without a bridge still run
+            import logging
+
+            logging.getLogger("kukeon.runner").warning(
+                "cell network attach failed for %s: %s", rec.name, e
+            )
 
     def _container_context(self, rec: model.CellRecord, spec: t.ContainerSpec) -> ContainerContext:
         cdir = self.store.container_dir(rec.realm, rec.space, rec.stack, rec.name, spec.name)
@@ -467,6 +490,9 @@ class Runner:
             self.devices.release(self._owner_key(rec))
             rec.status.tpu_chips = []
         if self.backend.isolated:
+            if self.netman is not None:
+                self.netman.detach_cell(rec.realm, rec.space, self._owner_key(rec))
+            rec.status.ip = None
             self.backend.teardown_sandbox(self._cell_dir(rec))
         self.store.write_cell(rec)
 
@@ -484,6 +510,8 @@ class Runner:
             for spec in self.cell_containers(rec):
                 self.backend.cleanup_container(self._container_context_bare(rec, spec))
             if self.backend.isolated:
+                if self.netman is not None:
+                    self.netman.detach_cell(realm, space, self._owner_key(rec))
                 self.backend.teardown_sandbox(self._cell_dir(rec))
             self.devices.release(self._owner_key(rec))
             self.store.delete_cell_tree(realm, space, stack, name)
@@ -526,6 +554,7 @@ class Runner:
                 and live.exited
                 and self._restart_due(spec, st)
             ):
+                self._ensure_cell_network(rec)   # sandbox may be recreated
                 ctx_full = self._container_context(rec, spec)
                 grant = self._chip_slices(containers, rec.status.tpu_chips).get(spec.name, [])
                 if grant:

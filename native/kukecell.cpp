@@ -124,27 +124,12 @@ static void bind_mount(const std::string& src, const std::string& dst,
         _exit(125);
     }
     if (S_ISDIR(st.st_mode)) {
-        // mkdir -p dst
-        std::string acc;
-        for (size_t i = 1; i <= dst.size(); i++) {
-            if (i == dst.size() || dst[i] == '/') {
-                acc = dst.substr(0, i);
-                mkdir(acc.c_str(), 0755);
-            }
-        }
+        mkdir_p(dst);
     } else {
         // Parent dirs + empty regular file as the bind target.
         size_t slash = dst.rfind('/');
-        if (slash != std::string::npos) {
-            std::string parent = dst.substr(0, slash);
-            std::string acc;
-            for (size_t i = 1; i <= parent.size(); i++) {
-                if (i == parent.size() || parent[i] == '/') {
-                    acc = parent.substr(0, i);
-                    mkdir(acc.c_str(), 0755);
-                }
-            }
-        }
+        if (slash != std::string::npos)
+            mkdir_p(dst.substr(0, slash));
         int fd = open(dst.c_str(), O_WRONLY | O_CREAT, 0644);
         if (fd >= 0) close(fd);
     }
@@ -163,6 +148,16 @@ static void bind_mount(const std::string& src, const std::string& dst,
 }
 
 struct BindSpec { std::string src, dst; bool ro; };
+
+static void mkdir_p(const std::string& path, mode_t mode = 0755) {
+    std::string acc;
+    for (size_t i = 1; i <= path.size(); i++) {
+        if (i == path.size() || path[i] == '/') {
+            acc = path.substr(0, i);
+            mkdir(acc.c_str(), mode);
+        }
+    }
+}
 
 // Overlayfs option values split on ':' and ','; image refs like name:tag
 // appear in store paths, so escape them (kernel accepts '\' escapes).
@@ -479,10 +474,25 @@ static int cmd_enter(int argc, char** argv) {
                 die("no_new_privs");
         }
         if (!user.empty()) {
-            uid_t uid = atoi(user.c_str());
+            // Numeric UID[:GID] only — a name silently atoi'ing to 0 would
+            // run the workload as root against the spec's intent.
+            char* end = nullptr;
+            uid_t uid = strtoul(user.c_str(), &end, 10);
             gid_t gid = uid;
-            size_t sep = user.find(':');
-            if (sep != std::string::npos) gid = atoi(user.c_str() + sep + 1);
+            if (end == user.c_str() || (*end != '\0' && *end != ':')) {
+                fprintf(stderr, "kukecell: --user wants numeric UID[:GID], "
+                        "got %s\n", user.c_str());
+                _exit(126);
+            }
+            if (*end == ':') {
+                char* gend = nullptr;
+                gid = strtoul(end + 1, &gend, 10);
+                if (gend == end + 1 || *gend != '\0') {
+                    fprintf(stderr, "kukecell: bad --user gid in %s\n",
+                            user.c_str());
+                    _exit(126);
+                }
+            }
             if (setgroups(0, nullptr) != 0) die("setgroups");
             if (setgid(gid) != 0) die("setgid");
             if (setuid(uid) != 0) die("setuid");
@@ -490,13 +500,7 @@ static int cmd_enter(int argc, char** argv) {
         if (!workdir.empty()) {
             // Builders commonly WORKDIR a dir no instruction made; create
             // it (in the writable overlay) like the OCI runtimes do.
-            std::string acc;
-            for (size_t n = 1; n <= workdir.size(); n++) {
-                if (n == workdir.size() || workdir[n] == '/') {
-                    acc = workdir.substr(0, n);
-                    mkdir(acc.c_str(), 0755);
-                }
-            }
+            mkdir_p(workdir);
             if (chdir(workdir.c_str()) != 0) {
                 fprintf(stderr, "kukecell: chdir %s: %s\n", workdir.c_str(),
                         strerror(errno));

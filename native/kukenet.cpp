@@ -1,0 +1,526 @@
+// kukenet: minimal iptables userspace for hosts without the iptables CLI.
+//
+// The kernel side of iptables (CONFIG_IP_NF_IPTABLES=y, xt_conntrack,
+// xt_state, xt_comment, xt_tcpudp) is compiled into many minimal hosts
+// that ship no userspace tools. kukenet speaks the xtables ABI directly —
+// IPT_SO_GET_INFO / IPT_SO_GET_ENTRIES / IPT_SO_SET_REPLACE on a raw
+// socket — so the egress-policy subsystem (the reference's
+// internal/netpolicy, enforcer.go:34-232) enforces for real instead of
+// degrading to no-op.
+//
+// Owns the WHOLE filter table: the caller (NetworkManager) composes the
+// complete desired rule set every reconcile tick and kukenet replaces the
+// table atomically in one kernel commit — the same fail-closed property
+// the reference gets from iptables-restore --noflush (a default-deny
+// chain never exists without its terminal DROP).
+//
+//   kukenet apply   — read the table spec from stdin (line protocol
+//                     below), build the ipt_replace blob, commit it.
+//   kukenet dump    — print the live filter table (chains + rules).
+//   kukenet check   — exit 0 if the kernel xtables ABI is usable.
+//
+// Line protocol (one directive per line, '#' comments):
+//   policy <INPUT|FORWARD|OUTPUT> <ACCEPT|DROP>
+//   chain <name>
+//   rule chain=<name> [src=CIDR] [dst=CIDR] [proto=tcp|udp] [dport=N]
+//        [in=IFACE[+]] [out=IFACE[+]] [state=EST_REL] [comment=...]
+//        verdict=<ACCEPT|DROP|RETURN|chain-name>
+// Rules append in input order; 'comment' must be the LAST key (it may
+// contain spaces).
+//
+// Build: g++ -O2 -o kukenet kukenet.cpp
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <linux/netfilter/x_tables.h>
+#include <linux/netfilter/xt_comment.h>
+#include <linux/netfilter/xt_state.h>
+#include <linux/netfilter/xt_tcpudp.h>
+#include <linux/netfilter_ipv4/ip_tables.h>
+#include <map>
+#include <netinet/in.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <vector>
+
+#define ALIGN8(x) (((x) + 7u) & ~7u)
+
+static const unsigned FILTER_HOOKS[] = {NF_INET_LOCAL_IN, NF_INET_FORWARD,
+                                        NF_INET_LOCAL_OUT};
+static const char* HOOK_NAMES[] = {"INPUT", "FORWARD", "OUTPUT"};
+
+// --- parsed model -----------------------------------------------------------
+
+struct RuleSpec {
+    std::string chain;
+    std::string src, dst;        // CIDR
+    std::string proto;           // "", "tcp", "udp"
+    int dport = -1;
+    std::string in_iface, out_iface;
+    bool state_est_rel = false;
+    std::string comment;
+    std::string verdict;         // ACCEPT | DROP | RETURN | <chain>
+};
+
+struct TableSpec {
+    std::map<std::string, std::string> policies = {
+        {"INPUT", "ACCEPT"}, {"FORWARD", "ACCEPT"}, {"OUTPUT", "ACCEPT"}};
+    std::vector<std::string> user_chains;   // declaration order
+    std::vector<RuleSpec> rules;            // global order
+};
+
+static bool parse_cidr(const std::string& cidr, in_addr* addr, in_addr* mask) {
+    std::string ip = cidr;
+    int prefix = 32;
+    size_t slash = cidr.find('/');
+    if (slash != std::string::npos) {
+        ip = cidr.substr(0, slash);
+        prefix = atoi(cidr.c_str() + slash + 1);
+    }
+    if (inet_pton(AF_INET, ip.c_str(), addr) != 1) return false;
+    uint32_t m = prefix == 0 ? 0 : htonl(~uint32_t(0) << (32 - prefix));
+    mask->s_addr = m;
+    addr->s_addr &= m;   // kernel requires the address pre-masked
+    return true;
+}
+
+static bool parse_spec(FILE* in, TableSpec* t, std::string* err) {
+    char buf[1024];
+    int lineno = 0;
+    while (fgets(buf, sizeof(buf), in)) {
+        lineno++;
+        std::string line = buf;
+        while (!line.empty() && (line.back() == '\n' || line.back() == '\r'))
+            line.pop_back();
+        if (line.empty() || line[0] == '#') continue;
+        size_t sp = line.find(' ');
+        std::string kw = line.substr(0, sp);
+        std::string rest = sp == std::string::npos ? "" : line.substr(sp + 1);
+        if (kw == "policy") {
+            size_t s2 = rest.find(' ');
+            std::string hook = rest.substr(0, s2);
+            std::string pol = s2 == std::string::npos ? "" : rest.substr(s2 + 1);
+            if (!t->policies.count(hook) || (pol != "ACCEPT" && pol != "DROP")) {
+                *err = "line " + std::to_string(lineno) + ": bad policy";
+                return false;
+            }
+            t->policies[hook] = pol;
+        } else if (kw == "chain") {
+            if (rest.empty() || rest.size() >= XT_EXTENSION_MAXNAMELEN) {
+                *err = "line " + std::to_string(lineno) + ": bad chain name";
+                return false;
+            }
+            t->user_chains.push_back(rest);
+        } else if (kw == "rule") {
+            RuleSpec r;
+            std::string remaining = rest;
+            while (!remaining.empty()) {
+                size_t eq = remaining.find('=');
+                if (eq == std::string::npos) break;
+                std::string key = remaining.substr(0, eq);
+                std::string val;
+                if (key == "comment") {       // consumes the rest of the line
+                    val = remaining.substr(eq + 1);
+                    remaining.clear();
+                } else {
+                    size_t end = remaining.find(' ', eq + 1);
+                    val = remaining.substr(eq + 1,
+                        end == std::string::npos ? std::string::npos : end - eq - 1);
+                    remaining = end == std::string::npos ? "" : remaining.substr(end + 1);
+                }
+                if (key == "chain") r.chain = val;
+                else if (key == "src") r.src = val;
+                else if (key == "dst") r.dst = val;
+                else if (key == "proto") r.proto = val;
+                else if (key == "dport") r.dport = atoi(val.c_str());
+                else if (key == "in") r.in_iface = val;
+                else if (key == "out") r.out_iface = val;
+                else if (key == "state") r.state_est_rel = (val == "EST_REL");
+                else if (key == "comment") r.comment = val;
+                else if (key == "verdict") r.verdict = val;
+                else {
+                    *err = "line " + std::to_string(lineno) + ": unknown key " + key;
+                    return false;
+                }
+            }
+            if (r.chain.empty() || r.verdict.empty()) {
+                *err = "line " + std::to_string(lineno) + ": rule needs chain= and verdict=";
+                return false;
+            }
+            t->rules.push_back(r);
+        } else {
+            *err = "line " + std::to_string(lineno) + ": unknown directive " + kw;
+            return false;
+        }
+    }
+    return true;
+}
+
+// --- blob building ----------------------------------------------------------
+
+struct Blob {
+    std::vector<uint8_t> data;
+    size_t append(const void* p, size_t n) {
+        size_t off = data.size();
+        data.insert(data.end(), (const uint8_t*)p, (const uint8_t*)p + n);
+        return off;
+    }
+    size_t pad_to(size_t aligned_size, size_t start) {
+        while (data.size() - start < aligned_size) data.push_back(0);
+        return data.size();
+    }
+};
+
+// Serialized sizes (all 8-aligned).
+static const size_t SZ_STD_TARGET =
+    ALIGN8(sizeof(xt_entry_target) + sizeof(int));
+static const size_t SZ_ERR_TARGET =
+    ALIGN8(sizeof(xt_entry_target) + XT_FUNCTION_MAXNAMELEN);
+
+static void set_iface(char* iface, unsigned char* mask, const std::string& spec) {
+    // "eth0" exact: mask covers name + NUL. "k-+" prefix: mask covers the
+    // prefix chars only.
+    bool prefix = !spec.empty() && spec.back() == '+';
+    std::string name = prefix ? spec.substr(0, spec.size() - 1) : spec;
+    snprintf(iface, IFNAMSIZ, "%s", name.c_str());
+    size_t n = prefix ? name.size() : name.size() + 1;
+    if (n > IFNAMSIZ) n = IFNAMSIZ;
+    memset(mask, 0xFF, n);
+}
+
+// Append one ipt_entry (with matches + target). Returns entry offset.
+static size_t emit_rule(Blob* b, const RuleSpec& r,
+                        const std::map<std::string, int>& builtin_verdicts,
+                        std::map<size_t, std::string>* pending_jumps,
+                        std::string* err) {
+    size_t start = b->data.size();
+    ipt_entry e = {};
+    if (!r.src.empty() && !parse_cidr(r.src, &e.ip.src, &e.ip.smsk)) {
+        *err = "bad src " + r.src;
+        return SIZE_MAX;
+    }
+    if (!r.dst.empty() && !parse_cidr(r.dst, &e.ip.dst, &e.ip.dmsk)) {
+        *err = "bad dst " + r.dst;
+        return SIZE_MAX;
+    }
+    if (!r.in_iface.empty()) {
+        std::string spec = r.in_iface;
+        if (spec[0] == '!') {           // "in=!IFACE" inverted match
+            e.ip.invflags |= IPT_INV_VIA_IN;
+            spec = spec.substr(1);
+        }
+        set_iface(e.ip.iniface, e.ip.iniface_mask, spec);
+    }
+    if (!r.out_iface.empty()) {
+        std::string spec = r.out_iface;
+        if (spec[0] == '!') {
+            e.ip.invflags |= IPT_INV_VIA_OUT;
+            spec = spec.substr(1);
+        }
+        set_iface(e.ip.outiface, e.ip.outiface_mask, spec);
+    }
+    if (r.proto == "tcp") e.ip.proto = IPPROTO_TCP;
+    else if (r.proto == "udp") e.ip.proto = IPPROTO_UDP;
+    b->append(&e, sizeof(e));
+
+    // Matches.
+    if (r.state_est_rel) {
+        size_t msz = ALIGN8(sizeof(xt_entry_match) + sizeof(xt_state_info));
+        std::vector<uint8_t> m(msz, 0);
+        auto* em = (xt_entry_match*)m.data();
+        em->u.user.match_size = msz;
+        snprintf(em->u.user.name, sizeof(em->u.user.name), "state");
+        auto* si = (xt_state_info*)(m.data() + sizeof(xt_entry_match));
+        // XT_STATE_BIT(IP_CT_ESTABLISHED)=2 | XT_STATE_BIT(IP_CT_RELATED)=4
+        si->statemask = 6;
+        b->append(m.data(), msz);
+    }
+    if (r.dport >= 0) {
+        size_t msz = ALIGN8(sizeof(xt_entry_match) + sizeof(xt_tcp));
+        std::vector<uint8_t> m(msz, 0);
+        auto* em = (xt_entry_match*)m.data();
+        em->u.user.match_size = msz;
+        bool udp = r.proto == "udp";
+        snprintf(em->u.user.name, sizeof(em->u.user.name), udp ? "udp" : "tcp");
+        if (udp) {
+            auto* x = (xt_udp*)(m.data() + sizeof(xt_entry_match));
+            x->spts[0] = 0; x->spts[1] = 0xFFFF;
+            x->dpts[0] = x->dpts[1] = (uint16_t)r.dport;
+        } else {
+            auto* x = (xt_tcp*)(m.data() + sizeof(xt_entry_match));
+            x->spts[0] = 0; x->spts[1] = 0xFFFF;
+            x->dpts[0] = x->dpts[1] = (uint16_t)r.dport;
+        }
+        b->append(m.data(), msz);
+    }
+    if (!r.comment.empty()) {
+        size_t msz = ALIGN8(sizeof(xt_entry_match) + sizeof(xt_comment_info));
+        std::vector<uint8_t> m(msz, 0);
+        auto* em = (xt_entry_match*)m.data();
+        em->u.user.match_size = msz;
+        snprintf(em->u.user.name, sizeof(em->u.user.name), "comment");
+        auto* ci = (xt_comment_info*)(m.data() + sizeof(xt_entry_match));
+        snprintf((char*)ci->comment, sizeof(ci->comment), "%s", r.comment.c_str());
+        b->append(m.data(), msz);
+    }
+
+    size_t target_off = b->data.size() - start;
+    // Target.
+    std::vector<uint8_t> tg(SZ_STD_TARGET, 0);
+    auto* et = (xt_entry_target*)tg.data();
+    et->u.user.target_size = SZ_STD_TARGET;
+    // Standard target: empty name.
+    auto it = builtin_verdicts.find(r.verdict);
+    int* verdict = (int*)(tg.data() + sizeof(xt_entry_target));
+    if (it != builtin_verdicts.end()) {
+        *verdict = it->second;
+    } else {
+        // Jump to user chain: patched once chain offsets are known.
+        (*pending_jumps)[b->data.size() + sizeof(xt_entry_target)] = r.verdict;
+        *verdict = 0;
+    }
+    b->append(tg.data(), tg.size());
+
+    auto* entry = (ipt_entry*)(b->data.data() + start);
+    entry->target_offset = target_off;
+    entry->next_offset = b->data.size() - start;
+    return start;
+}
+
+static size_t emit_unconditional(Blob* b, int verdict) {
+    size_t start = b->data.size();
+    ipt_entry e = {};
+    e.target_offset = sizeof(ipt_entry);
+    e.next_offset = sizeof(ipt_entry) + SZ_STD_TARGET;
+    b->append(&e, sizeof(e));
+    std::vector<uint8_t> tg(SZ_STD_TARGET, 0);
+    auto* et = (xt_entry_target*)tg.data();
+    et->u.user.target_size = SZ_STD_TARGET;
+    *(int*)(tg.data() + sizeof(xt_entry_target)) = verdict;
+    b->append(tg.data(), tg.size());
+    return start;
+}
+
+static size_t emit_error_node(Blob* b, const std::string& name) {
+    size_t start = b->data.size();
+    ipt_entry e = {};
+    e.target_offset = sizeof(ipt_entry);
+    e.next_offset = sizeof(ipt_entry) + SZ_ERR_TARGET;
+    b->append(&e, sizeof(e));
+    std::vector<uint8_t> tg(SZ_ERR_TARGET, 0);
+    auto* et = (xt_entry_target*)tg.data();
+    et->u.user.target_size = SZ_ERR_TARGET;
+    snprintf(et->u.user.name, sizeof(et->u.user.name), "ERROR");
+    snprintf((char*)tg.data() + sizeof(xt_entry_target),
+             XT_FUNCTION_MAXNAMELEN, "%s", name.c_str());
+    b->append(tg.data(), tg.size());
+    return start;
+}
+
+static const int V_ACCEPT = -NF_ACCEPT - 1;   // -2
+static const int V_DROP = -NF_DROP - 1;       // -1
+static const int V_RETURN = XT_RETURN;        // -NF_REPEAT-1 = -5
+
+static int cmd_apply() {
+    TableSpec spec;
+    std::string err;
+    if (!parse_spec(stdin, &spec, &err)) {
+        fprintf(stderr, "kukenet: %s\n", err.c_str());
+        return 2;
+    }
+    std::map<std::string, int> builtin = {
+        {"ACCEPT", V_ACCEPT}, {"DROP", V_DROP}, {"RETURN", V_RETURN}};
+
+    Blob b;
+    unsigned hook_entry[NF_INET_NUMHOOKS] = {};
+    unsigned underflow[NF_INET_NUMHOOKS] = {};
+    unsigned num_entries = 0;
+    std::map<size_t, std::string> pending;  // offset of verdict int -> chain
+    std::map<std::string, size_t> chain_start;
+
+    for (int h = 0; h < 3; h++) {
+        const char* hn = HOOK_NAMES[h];
+        hook_entry[FILTER_HOOKS[h]] = b.data.size();
+        for (const auto& r : spec.rules) {
+            if (r.chain != hn) continue;
+            if (emit_rule(&b, r, builtin, &pending, &err) == SIZE_MAX) {
+                fprintf(stderr, "kukenet: %s\n", err.c_str());
+                return 2;
+            }
+            num_entries++;
+        }
+        underflow[FILTER_HOOKS[h]] = b.data.size();
+        emit_unconditional(&b, spec.policies[hn] == "DROP" ? V_DROP : V_ACCEPT);
+        num_entries++;
+    }
+    for (const auto& cn : spec.user_chains) {
+        emit_error_node(&b, cn);
+        num_entries++;
+        chain_start[cn] = b.data.size();   // first rule of the chain
+        for (const auto& r : spec.rules) {
+            if (r.chain != cn) continue;
+            if (emit_rule(&b, r, builtin, &pending, &err) == SIZE_MAX) {
+                fprintf(stderr, "kukenet: %s\n", err.c_str());
+                return 2;
+            }
+            num_entries++;
+        }
+        emit_unconditional(&b, V_RETURN);   // implicit chain policy
+        num_entries++;
+    }
+    emit_error_node(&b, "ERROR");
+    num_entries++;
+
+    // Patch user-chain jumps (verdict = offset of the chain's ERROR node;
+    // the kernel skips the node and enters the first rule).
+    for (const auto& [off, chain] : pending) {
+        auto it = chain_start.find(chain);
+        if (it == chain_start.end()) {
+            fprintf(stderr, "kukenet: jump to undeclared chain %s\n", chain.c_str());
+            return 2;
+        }
+        *(int*)(b.data.data() + off) = (int)it->second;
+    }
+
+    int fd = socket(AF_INET, SOCK_RAW, IPPROTO_RAW);
+    if (fd < 0) { perror("kukenet: socket"); return 1; }
+
+    // Old counter count for the replace call.
+    ipt_getinfo info = {};
+    snprintf(info.name, sizeof(info.name), "filter");
+    socklen_t ilen = sizeof(info);
+    if (getsockopt(fd, IPPROTO_IP, IPT_SO_GET_INFO, &info, &ilen) != 0) {
+        perror("kukenet: IPT_SO_GET_INFO");
+        close(fd);
+        return 1;
+    }
+
+    std::vector<uint8_t> rep(sizeof(ipt_replace) + b.data.size());
+    auto* r = (ipt_replace*)rep.data();
+    snprintf(r->name, sizeof(r->name), "filter");
+    r->valid_hooks = info.valid_hooks;
+    r->num_entries = num_entries;
+    r->size = b.data.size();
+    memcpy(r->hook_entry, hook_entry, sizeof(hook_entry));
+    memcpy(r->underflow, underflow, sizeof(underflow));
+    // Unused hooks must still carry valid offsets? For filter the kernel
+    // checks only hooks in valid_hooks; leave the rest zero.
+    std::vector<xt_counters> old_counters(info.num_entries);
+    r->num_counters = info.num_entries;
+    r->counters = old_counters.data();
+    memcpy(r->entries, b.data.data(), b.data.size());
+
+    if (setsockopt(fd, IPPROTO_IP, IPT_SO_SET_REPLACE, rep.data(),
+                   rep.size()) != 0) {
+        perror("kukenet: IPT_SO_SET_REPLACE");
+        close(fd);
+        return 1;
+    }
+    close(fd);
+    return 0;
+}
+
+static int cmd_dump() {
+    int fd = socket(AF_INET, SOCK_RAW, IPPROTO_RAW);
+    if (fd < 0) { perror("kukenet: socket"); return 1; }
+    ipt_getinfo info = {};
+    snprintf(info.name, sizeof(info.name), "filter");
+    socklen_t ilen = sizeof(info);
+    if (getsockopt(fd, IPPROTO_IP, IPT_SO_GET_INFO, &info, &ilen) != 0) {
+        perror("kukenet: IPT_SO_GET_INFO");
+        return 1;
+    }
+    std::vector<uint8_t> buf(sizeof(ipt_get_entries) + info.size);
+    auto* ge = (ipt_get_entries*)buf.data();
+    snprintf(ge->name, sizeof(ge->name), "filter");
+    ge->size = info.size;
+    socklen_t glen = buf.size();
+    if (getsockopt(fd, IPPROTO_IP, IPT_SO_GET_ENTRIES, buf.data(), &glen) != 0) {
+        perror("kukenet: IPT_SO_GET_ENTRIES");
+        return 1;
+    }
+    close(fd);
+
+    printf("# filter table: %u entries, %u bytes, hooks 0x%x\n",
+           info.num_entries, info.size, info.valid_hooks);
+    size_t off = 0;
+    std::string cur = "";
+    for (int h = 0; h < 3; h++)
+        printf("# hook %s at %u, underflow %u\n", HOOK_NAMES[h],
+               info.hook_entry[FILTER_HOOKS[h]], info.underflow[FILTER_HOOKS[h]]);
+    while (off < info.size) {
+        auto* e = (ipt_entry*)((uint8_t*)ge->entrytable + off);
+        auto* tgt = (xt_entry_target*)((uint8_t*)e + e->target_offset);
+        for (int h = 0; h < 3; h++)
+            if (off == info.hook_entry[FILTER_HOOKS[h]]) cur = HOOK_NAMES[h];
+        if (strcmp(tgt->u.user.name, "ERROR") == 0) {
+            const char* nm = (const char*)tgt + sizeof(xt_entry_target);
+            if (strcmp(nm, "ERROR") != 0) {
+                cur = nm;
+                printf("chain %s\n", nm);
+            }
+        } else {
+            char src[32] = "any", dst[32] = "any";
+            if (e->ip.smsk.s_addr) {
+                inet_ntop(AF_INET, &e->ip.src, src, sizeof(src));
+            }
+            if (e->ip.dmsk.s_addr) {
+                inet_ntop(AF_INET, &e->ip.dst, dst, sizeof(dst));
+            }
+            printf("rule chain=%s src=%s dst=%s proto=%u in=%s ",
+                   cur.c_str(), src, dst, e->ip.proto,
+                   e->ip.iniface[0] ? e->ip.iniface : "any");
+            // Matches.
+            size_t moff = sizeof(ipt_entry);
+            while (moff < e->target_offset) {
+                auto* m = (xt_entry_match*)((uint8_t*)e + moff);
+                printf("match=%s ", m->u.user.name);
+                moff += m->u.user.match_size;
+            }
+            if (tgt->u.user.name[0] == '\0') {
+                int v = *(int*)((uint8_t*)tgt + sizeof(xt_entry_target));
+                if (v == V_ACCEPT) printf("verdict=ACCEPT");
+                else if (v == V_DROP) printf("verdict=DROP");
+                else if (v == V_RETURN) printf("verdict=RETURN");
+                else printf("verdict=jump:%d", v);
+            } else {
+                printf("verdict=%s", tgt->u.user.name);
+            }
+            printf(" pkts=%llu bytes=%llu\n",
+                   (unsigned long long)e->counters.pcnt,
+                   (unsigned long long)e->counters.bcnt);
+        }
+        off += e->next_offset;
+        if (e->next_offset == 0) break;
+    }
+    return 0;
+}
+
+static int cmd_check() {
+    int fd = socket(AF_INET, SOCK_RAW, IPPROTO_RAW);
+    if (fd < 0) return 1;
+    ipt_getinfo info = {};
+    snprintf(info.name, sizeof(info.name), "filter");
+    socklen_t ilen = sizeof(info);
+    int rc = getsockopt(fd, IPPROTO_IP, IPT_SO_GET_INFO, &info, &ilen);
+    close(fd);
+    return rc == 0 ? 0 : 1;
+}
+
+int main(int argc, char** argv) {
+    if (argc < 2) {
+        fprintf(stderr, "usage: kukenet apply|dump|check\n");
+        return 2;
+    }
+    std::string mode = argv[1];
+    if (mode == "apply") return cmd_apply();
+    if (mode == "dump") return cmd_dump();
+    if (mode == "check") return cmd_check();
+    fprintf(stderr, "kukenet: unknown mode %s\n", mode.c_str());
+    return 2;
+}

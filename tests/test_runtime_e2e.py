@@ -24,7 +24,7 @@ CLI = [sys.executable, "-m", "kukeon_tpu.runtime.cli"]
 
 
 class Daemon:
-    def __init__(self, chips: str = "0,1"):
+    def __init__(self, chips: str = "0,1", env_overrides: dict | None = None):
         self.run_path = tempfile.mkdtemp(prefix="kuke-e2e-")
         self.socket_path = f"/tmp/kuked-{uuid.uuid4().hex[:8]}.sock"
         env = dict(os.environ)
@@ -34,6 +34,7 @@ class Daemon:
             "JAX_PLATFORMS": "cpu",
             "PYTHONPATH": REPO,
         })
+        env.update(env_overrides or {})
         self.env = env
         self.proc = subprocess.Popen(
             CLI + ["daemon", "serve", "--run-path", self.run_path,
