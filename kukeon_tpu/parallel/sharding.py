@@ -59,14 +59,37 @@ def specs_for_params(params, fsdp: bool = False) -> dict:
     return {k: full[k] for k in params}
 
 
+def _quant_scale_spec(spec: P, q, s) -> P:
+    """Spec for an int8 scale vector: the matrix spec minus the contracted
+    axis (scale spans the non-contracted axis/axes)."""
+    if q.ndim == 3:                      # stacked [L, in, out] -> s [L, out]
+        return P(spec[0], spec[2])
+    # 2-D: s aligns with whichever matrix axis it matches in size.
+    return P(spec[0] if s.shape[0] == q.shape[0] else spec[1])
+
+
 def shard_params(params, mesh: Mesh, fsdp: bool = False):
-    """Device-put a param pytree with the canonical shardings."""
+    """Device-put a param pytree with the canonical shardings.
+
+    Quantized leaves ({"q": int8 matrix, "s": scale}) inherit the matrix
+    spec for q; the scale shards with the matrix's surviving axes."""
     specs = specs_for_params(params, fsdp)
-    shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), specs,
+
+    def put(spec, leaf):
+        if isinstance(leaf, dict) and "q" in leaf:
+            return {
+                "q": jax.device_put(leaf["q"], NamedSharding(mesh, spec)),
+                "s": jax.device_put(
+                    leaf["s"],
+                    NamedSharding(mesh, _quant_scale_spec(spec, leaf["q"], leaf["s"])),
+                ),
+            }
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(
+        put, specs, params,
         is_leaf=lambda x: isinstance(x, P),
     )
-    return jax.device_put(params, shardings)
 
 
 def bert_param_specs(fsdp: bool = False) -> dict:

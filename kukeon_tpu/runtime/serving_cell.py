@@ -73,22 +73,29 @@ class ServingCell:
                 f"unknown model {model!r}; known: "
                 f"{sorted(MODELS) + sorted(EMBEDDING_MODELS)}"
             )
+        import dataclasses
+
+        # "int8" quantizes the weights post-load (activations stay bf16);
+        # other dtype strings set the activation/weight dtype directly.
+        quantize = dtype == "int8"
         cfg = MODELS[model]()
-        if dtype:
+        if dtype and not quantize:
             import jax.numpy as jnp
 
-            cfg = __import__("dataclasses").replace(cfg, dtype=getattr(jnp, dtype))
+            cfg = dataclasses.replace(cfg, dtype=getattr(jnp, dtype))
         if max_seq_len:
-            cfg = __import__("dataclasses").replace(cfg, max_seq_len=max_seq_len)
+            cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
 
         n = len(jax.devices())
         shape = auto_mesh_shape(n)
         mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
 
         if checkpoint:
-            params = self._load_checkpoint(checkpoint, cfg)
+            params, cfg = self._load_checkpoint(checkpoint, cfg)
         else:
             params = llama.init_params(jax.random.key(seed), cfg)
+        if quantize:
+            params = llama.quantize_params(params)
 
         self.model_name = model
         self.cfg = cfg
@@ -96,21 +103,32 @@ class ServingCell:
             cfg, params, mesh, num_slots=num_slots,
             max_seq_len=max_seq_len or min(cfg.max_seq_len, 4096),
         )
-        self.tokenizer = ByteTokenizer()
+        from kukeon_tpu.serving.tokenizer import load_tokenizer
+
+        self.tokenizer = load_tokenizer(checkpoint)
         self.started_at = time.time()
         self.total_tokens = 0
         self._stats_lock = threading.Lock()
 
     @staticmethod
     def _load_checkpoint(path: str, cfg):
+        """(params, cfg): HF safetensors directories (config.json +
+        *.safetensors — the hub layout) or an orbax checkpoint path."""
+        import os
+
         import jax
-        import orbax.checkpoint as ocp
 
         from kukeon_tpu.models import llama
 
+        if os.path.isdir(path) and os.path.exists(os.path.join(path, "config.json")):
+            from kukeon_tpu.models import hf_convert
+
+            return hf_convert.load_params(path, dtype=cfg.dtype)
+        import orbax.checkpoint as ocp
+
         abstract = jax.eval_shape(lambda k: llama.init_params(k, cfg), jax.random.key(0))
         ckptr = ocp.StandardCheckpointer()
-        return ckptr.restore(path, abstract)
+        return ckptr.restore(path, abstract), cfg
 
     def warmup(self, prompt_len: int = 64):
         self.engine.warmup(prompt_len)

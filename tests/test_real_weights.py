@@ -1,0 +1,207 @@
+"""Real-weights serving path: HF safetensors conversion, tokenizer.json
+loading, int8 quantization (VERDICT r1 item 3).
+
+No network access in CI, so "real" checkpoints are synthesized in the HF
+hub layout (config.json + sharded safetensors + tokenizer.json) and
+round-tripped through the exact code paths a downloaded Llama-3 would use.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kukeon_tpu.models import hf_convert, llama
+
+
+def _tiny_hf_checkpoint(tmp_path, shards: int = 1, tie: bool = False):
+    """Write a llama_tiny-shaped checkpoint in HF hub layout."""
+    cfg = llama.llama_tiny()
+    cfg = __import__("dataclasses").replace(cfg, tie_embeddings=tie)
+    rng = np.random.default_rng(0)
+    H, I, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    tensors: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": rng.standard_normal((V, H), np.float32),
+        "model.norm.weight": np.ones(H, np.float32),
+    }
+    if not tie:
+        tensors["lm_head.weight"] = rng.standard_normal((V, H), np.float32)
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        tensors.update({
+            p + "input_layernorm.weight": np.ones(H, np.float32),
+            p + "self_attn.q_proj.weight": rng.standard_normal((cfg.q_dim, H), np.float32),
+            p + "self_attn.k_proj.weight": rng.standard_normal((cfg.kv_dim, H), np.float32),
+            p + "self_attn.v_proj.weight": rng.standard_normal((cfg.kv_dim, H), np.float32),
+            p + "self_attn.o_proj.weight": rng.standard_normal((H, cfg.q_dim), np.float32),
+            p + "post_attention_layernorm.weight": np.ones(H, np.float32),
+            p + "mlp.gate_proj.weight": rng.standard_normal((I, H), np.float32),
+            p + "mlp.up_proj.weight": rng.standard_normal((I, H), np.float32),
+            p + "mlp.down_proj.weight": rng.standard_normal((H, I), np.float32),
+        })
+    from safetensors.numpy import save_file
+
+    names = sorted(tensors)
+    if shards == 1:
+        save_file(tensors, str(tmp_path / "model.safetensors"))
+    else:
+        weight_map = {}
+        for si in range(shards):
+            part = {n: tensors[n] for n in names[si::shards]}
+            fname = f"model-{si + 1:05d}-of-{shards:05d}.safetensors"
+            save_file(part, str(tmp_path / fname))
+            weight_map.update({n: fname for n in part})
+        (tmp_path / "model.safetensors.index.json").write_text(
+            json.dumps({"weight_map": weight_map})
+        )
+    (tmp_path / "config.json").write_text(json.dumps({
+        "vocab_size": V, "hidden_size": H, "intermediate_size": I,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.head_dim,
+        "rope_theta": cfg.rope_theta, "rms_norm_eps": cfg.rms_norm_eps,
+        "max_position_embeddings": cfg.max_seq_len,
+        "tie_word_embeddings": tie,
+    }))
+    return cfg, tensors
+
+
+class TestHFConvert:
+    def test_single_file_roundtrip(self, tmp_path):
+        cfg, tensors = _tiny_hf_checkpoint(tmp_path)
+        params, loaded_cfg = hf_convert.load_params(str(tmp_path), dtype=jnp.float32)
+        assert loaded_cfg.num_layers == cfg.num_layers
+        assert loaded_cfg.tie_embeddings is False
+        # HF [out, in] transposed into our [in, out], stacked on layers.
+        np.testing.assert_allclose(
+            np.asarray(params["layers"]["wq"][0]),
+            tensors["model.layers.0.self_attn.q_proj.weight"].T,
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(params["lm_head"]),
+            tensors["lm_head.weight"].T, rtol=1e-6,
+        )
+        # The loaded tree runs.
+        tokens = jnp.array([[1, 2, 3, 4]], jnp.int32)
+        pos = jnp.arange(4, dtype=jnp.int32)[None, :]
+        logits, _ = llama.forward(params, loaded_cfg, tokens, pos)
+        assert logits.shape == (1, 4, cfg.vocab_size)
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_sharded_index(self, tmp_path):
+        cfg, tensors = _tiny_hf_checkpoint(tmp_path, shards=3)
+        params, _ = hf_convert.load_params(str(tmp_path), dtype=jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(params["layers"]["w_down"][1]),
+            tensors["model.layers.1.mlp.down_proj.weight"].T, rtol=1e-6,
+        )
+
+    def test_tied_embeddings(self, tmp_path):
+        cfg, _ = _tiny_hf_checkpoint(tmp_path, tie=True)
+        params, loaded_cfg = hf_convert.load_params(str(tmp_path), dtype=jnp.float32)
+        assert loaded_cfg.tie_embeddings is True
+        assert "lm_head" not in params
+
+    def test_unmapped_tensor_rejected(self, tmp_path):
+        """An architecture mismatch must fail loudly, not silently drop."""
+        _tiny_hf_checkpoint(tmp_path)
+        from safetensors import safe_open
+        from safetensors.numpy import save_file
+
+        path = str(tmp_path / "model.safetensors")
+        with safe_open(path, framework="numpy") as f:
+            tensors = {n: f.get_tensor(n) for n in f.keys()}
+        tensors["model.mystery.weight"] = np.ones(4, np.float32)
+        save_file(tensors, path)
+        with pytest.raises(ValueError, match="unmapped"):
+            hf_convert.load_params(str(tmp_path))
+
+
+class TestQuantization:
+    def test_prefill_close_and_decode_argmax_agrees(self):
+        cfg = llama.llama_tiny()
+        params = llama.init_params(jax.random.key(0), cfg)
+        qp = llama.quantize_params(params)
+        prompt = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+        pos = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32)[None, :], (2, 16))
+        lg, _ = llama.forward(params, cfg, prompt, pos)
+        lgq, _ = llama.forward(qp, cfg, prompt, pos)
+        rel = float(jnp.abs(lg - lgq).max() / jnp.abs(lg).max())
+        assert rel < 0.05
+
+        cache_f = llama.KVCache.create(cfg, batch=2, max_len=64)
+        cache_q = llama.KVCache.create(cfg, batch=2, max_len=64)
+        _, cache_f = llama.forward(params, cfg, prompt, pos, cache=cache_f)
+        _, cache_q = llama.forward(qp, cfg, prompt, pos, cache=cache_q)
+        t = jnp.array([[5], [7]], jnp.int32)
+        lg1, _ = llama.forward(params, cfg, t, cache_f.lengths[:, None], cache=cache_f)
+        lg1q, _ = llama.forward(qp, cfg, t, cache_q.lengths[:, None], cache=cache_q)
+        assert bool((lg1.argmax(-1) == lg1q.argmax(-1)).all())
+
+    def test_quantized_bytes_halve(self):
+        cfg = llama.llama_tiny()
+        params = llama.init_params(jax.random.key(0), cfg)
+        qp = llama.quantize_params(params)
+
+        def nbytes(tree):
+            return sum(x.size * x.dtype.itemsize
+                       for x in jax.tree_util.tree_leaves(tree))
+
+        # tiny is f32 -> int8 is ~4x smaller; scales add a little back.
+        assert nbytes(qp) < nbytes(params) / 3
+
+    def test_engine_serves_quantized(self):
+        from kukeon_tpu.parallel import make_mesh
+        from kukeon_tpu.serving import SamplingParams, ServingEngine
+
+        cfg = llama.llama_tiny()
+        qp = llama.quantize_params(llama.init_params(jax.random.key(0), cfg))
+        mesh = make_mesh(tensor=2, devices=jax.devices()[:2])
+        engine = ServingEngine(cfg, qp, mesh, num_slots=2, max_seq_len=128)
+        out = engine.generate(
+            np.array([3, 1, 4, 1, 5], np.int32),
+            SamplingParams(temperature=0.0, max_new_tokens=8),
+        )
+        assert len(out) == 8
+
+
+class TestTokenizer:
+    def _write_tokenizer(self, tmp_path):
+        from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+        tk = Tokenizer(models.BPE(unk_token=None))
+        tk.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+        tk.decoder = decoders.ByteLevel()   # real Llama tokenizer.json has one
+        trainer = trainers.BpeTrainer(
+            vocab_size=300,
+            special_tokens=["<|begin_of_text|>", "<|end_of_text|>"],
+            initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        )
+        tk.train_from_iterator(
+            ["the quick brown fox jumps over the lazy dog"] * 50, trainer
+        )
+        path = tmp_path / "tokenizer.json"
+        tk.save(str(path))
+        return path
+
+    def test_hf_tokenizer_roundtrip(self, tmp_path):
+        from kukeon_tpu.serving.tokenizer import load_tokenizer
+
+        self._write_tokenizer(tmp_path)
+        tok = load_tokenizer(str(tmp_path))
+        ids = tok.encode("the quick fox")
+        assert ids[0] == tok.bos_id
+        assert tok.decode(ids).strip() == "the quick fox"
+
+    def test_byte_fallback(self, tmp_path):
+        from kukeon_tpu.serving.tokenizer import ByteTokenizer, load_tokenizer
+
+        tok = load_tokenizer(str(tmp_path))   # no tokenizer.json here
+        assert isinstance(tok, ByteTokenizer)
+        assert tok.decode(tok.encode("hello")) == "hello"
