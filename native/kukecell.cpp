@@ -116,6 +116,8 @@ static void drop_bounding_set(const std::vector<int>& keep) {
 
 // --- mounts ----------------------------------------------------------------
 
+static void mkdir_p(const std::string& path, mode_t mode = 0755);
+
 static void bind_mount(const std::string& src, const std::string& dst,
                        bool read_only, bool recursive) {
     struct stat st;
@@ -149,7 +151,7 @@ static void bind_mount(const std::string& src, const std::string& dst,
 
 struct BindSpec { std::string src, dst; bool ro; };
 
-static void mkdir_p(const std::string& path, mode_t mode = 0755) {
+static void mkdir_p(const std::string& path, mode_t mode) {
     std::string acc;
     for (size_t i = 1; i <= path.size(); i++) {
         if (i == path.size() || path[i] == '/') {
