@@ -45,6 +45,13 @@ class LlamaConfig:
     max_seq_len: int = 8192
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
+    # Route quantized decode matmuls through the Pallas int8 kernel
+    # (ops/int8_matmul.py) instead of XLA's dequant-fused dot. Measured at
+    # parity with XLA 0.9's fusion on v5e (both stream int8 at the HBM roof);
+    # kept as an explicit switch so the kernel path stays exercised and the
+    # win is guaranteed on XLA versions whose fusion regresses. Enable via
+    # ServingEngine(int8_pallas=...) or directly; ignored for bf16 params.
+    int8_pallas: bool = False
 
     @property
     def q_dim(self) -> int:
@@ -209,6 +216,23 @@ def quantize_params(params: Params) -> Params:
     return out
 
 
+def quantize_np(w, axis: int):
+    """Per-output-channel symmetric int8 on the host (numpy): w ~= q * s.
+
+    The single source of truth for the numpy quantization recipe — host
+    loaders (hf_convert.load_params_quantized, init_quantized_params_host)
+    must match :func:`quantize_params`'s device recipe exactly, or
+    streamed-vs-quantized trees silently diverge.
+    """
+    import numpy as np
+
+    w = np.asarray(w, np.float32)
+    a = np.max(np.abs(w), axis=axis, keepdims=True)
+    s = np.maximum(a / 127.0, 1e-12).astype(np.float32)
+    q = np.round(w / s).astype(np.int8)
+    return {"q": q, "s": np.squeeze(s, axis=axis)}
+
+
 def init_quantized_params_host(cfg: LlamaConfig, seed: int = 0) -> Params:
     """Random-init DIRECTLY in int8 on the host, leaf by leaf.
 
@@ -224,10 +248,7 @@ def init_quantized_params_host(cfg: LlamaConfig, seed: int = 0) -> Params:
 
     def q(shape, fan_in, axis):
         w = rng.standard_normal(shape, np.float32) * (fan_in ** -0.5)
-        a = np.max(np.abs(w), axis=axis, keepdims=True)
-        s = np.maximum(a / 127.0, 1e-12)
-        qw = np.round(w / s).astype(np.int8)
-        return {"q": qw, "s": np.squeeze(s, axis=axis)}
+        return quantize_np(w, axis)
 
     params: Params = {
         "embed": q((V, H), H, 1),
@@ -253,9 +274,20 @@ def _is_q(w) -> bool:
     return isinstance(w, dict) and "q" in w
 
 
-def _mm(h: jnp.ndarray, w) -> jnp.ndarray:
-    """h @ w for plain or quantized weights (dequant fused into the dot)."""
+def _mm(h: jnp.ndarray, w, pallas: bool = False) -> jnp.ndarray:
+    """h @ w for plain or quantized weights (dequant fused into the dot).
+
+    ``pallas=True`` routes int8 weights through the Pallas kernel (decode
+    path); the kernel itself falls back to the XLA fused dot for odd shapes
+    or large batches (prefill), so callers can pass the flag unconditionally.
+    """
     if _is_q(w):
+        if pallas:
+            from kukeon_tpu.ops.int8_matmul import int8_matmul
+
+            lead = h.shape[:-1]
+            out = int8_matmul(h.reshape(-1, h.shape[-1]), w["q"], w["s"])
+            return out.reshape(*lead, out.shape[-1])
         return (h @ w["q"].astype(h.dtype)) * w["s"].astype(h.dtype)
     return h @ w
 
@@ -268,14 +300,23 @@ def _embed(params: Params, tokens: jnp.ndarray, dtype) -> jnp.ndarray:
     return jnp.take(e, tokens, axis=0).astype(dtype)
 
 
-def _logits(params: Params, c: LlamaConfig, x: jnp.ndarray) -> jnp.ndarray:
+def _logits(params: Params, c: LlamaConfig, x: jnp.ndarray,
+            pallas: bool = False) -> jnp.ndarray:
     if c.tie_embeddings:
         e = params["embed"]
         if _is_q(e):
+            if pallas:
+                from kukeon_tpu.ops.int8_matmul import int8_matmul
+
+                lead = x.shape[:-1]
+                out = int8_matmul(
+                    x.reshape(-1, x.shape[-1]), e["q"], e["s"], transpose=True
+                )
+                return out.reshape(*lead, out.shape[-1]).astype(jnp.float32)
             raw = jnp.einsum("bsh,vh->bsv", x, e["q"].astype(x.dtype))
             return (raw * e["s"].astype(x.dtype)).astype(jnp.float32)
         return jnp.einsum("bsh,vh->bsv", x, e).astype(jnp.float32)
-    return _mm(x, params["lm_head"]).astype(jnp.float32)
+    return _mm(x, params["lm_head"], pallas).astype(jnp.float32)
 
 
 # --- Forward -----------------------------------------------------------------
@@ -393,23 +434,24 @@ def _decode_forward(
     from kukeon_tpu.ops.attention import decode_gqa_attention
 
     offsets = cache.lengths
+    pl8 = c.int8_pallas
 
     def layer_step(x, layer):
         w, ck, cv = layer
         h = rms_norm(x, w["attn_norm"], c.rms_norm_eps)
-        q = _mm(h, w["wq"]).reshape(B, 1, c.num_heads, c.head_dim)
-        k = _mm(h, w["wk"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
-        v = _mm(h, w["wv"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
+        q = _mm(h, w["wq"], pl8).reshape(B, 1, c.num_heads, c.head_dim)
+        k = _mm(h, w["wk"], pl8).reshape(B, 1, c.num_kv_heads, c.head_dim)
+        v = _mm(h, w["wv"], pl8).reshape(B, 1, c.num_kv_heads, c.head_dim)
         q = apply_rope(q, positions, c.rope_theta)
         k = apply_rope(k, positions, c.rope_theta)
 
         attn = decode_gqa_attention(q, k, v, ck, cv, offsets)
-        x = x + _mm(attn.reshape(B, 1, c.q_dim), w["wo"])
+        x = x + _mm(attn.reshape(B, 1, c.q_dim), w["wo"], pl8)
 
         h = rms_norm(x, w["mlp_norm"], c.rms_norm_eps)
-        gate = jax.nn.silu(_mm(h, w["w_gate"]).astype(jnp.float32)).astype(c.dtype)
-        up = _mm(h, w["w_up"])
-        x = x + _mm(gate * up, w["w_down"])
+        gate = jax.nn.silu(_mm(h, w["w_gate"], pl8).astype(jnp.float32)).astype(c.dtype)
+        up = _mm(h, w["w_up"], pl8)
+        x = x + _mm(gate * up, w["w_down"], pl8)
         return x, (k, v)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -427,4 +469,4 @@ def _decode_forward(
     new_cache = KVCache(k=k_upd, v=v_upd, lengths=cache.lengths + 1)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
-    return _logits(params, c, x), new_cache
+    return _logits(params, c, x, pl8), new_cache

@@ -62,8 +62,11 @@ def int8_matmul(h: jnp.ndarray, q: jnp.ndarray, s: jnp.ndarray,
     """
     B, K = h.shape
     N = q.shape[0] if transpose else q.shape[1]
-    if (K % 128) or (N % 128):
-        # Odd shapes (tests, tiny models): plain XLA fallback.
+    if (K % 128) or (N % 128) or B > 64 or jax.default_backend() != "tpu":
+        # Odd shapes (tests, tiny models), prefill-sized batches (the [Bp, K]
+        # activation block must stay far under VMEM; prefill is MXU-bound so
+        # XLA's dequant-fused dot is the right tool there), and non-TPU
+        # backends: plain XLA fallback.
         w = q.astype(h.dtype)
         out = jax.lax.dot_general(
             h, w, (((1,), (1 if transpose else 0,)), ((), ())))

@@ -91,11 +91,14 @@ class ServingCell:
         mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
 
         if checkpoint:
-            params, cfg = self._load_checkpoint(checkpoint, cfg)
+            params, cfg = self._load_checkpoint(checkpoint, cfg, quantize)
+        elif quantize:
+            # Random-init directly in int8 on the host: an 8B bf16 tree
+            # (~16 GB) cannot be materialized on a 16 GB chip just to be
+            # quantized (models/llama.py init_quantized_params_host).
+            params = llama.init_quantized_params_host(cfg, seed)
         else:
             params = llama.init_params(jax.random.key(seed), cfg)
-        if quantize:
-            params = llama.quantize_params(params)
 
         self.model_name = model
         self.cfg = cfg
@@ -111,18 +114,30 @@ class ServingCell:
         self._stats_lock = threading.Lock()
 
     @staticmethod
-    def _load_checkpoint(path: str, cfg):
-        """(params, cfg): HF safetensors directories (config.json +
-        *.safetensors — the hub layout) or an orbax checkpoint path."""
+    def _load_checkpoint(path: str, cfg, quantize: bool = False):
+        """(params, cfg) from, in precedence order:
+
+        - a kukeon int8 quantized checkpoint (kukeon_quant.json manifest) —
+          the cold-start fast path: int8 streams straight to the device with
+          zero quantization work;
+        - an HF safetensors directory (config.json + *.safetensors, the hub
+          layout) — streamed and host-quantized when ``quantize`` (an 8B
+          bf16 tree cannot be materialized on a 16 GB chip);
+        - an orbax checkpoint path.
+        """
         import os
 
         import jax
 
-        from kukeon_tpu.models import llama
+        from kukeon_tpu.models import checkpoints, llama
 
+        if checkpoints.is_quantized_checkpoint(path):
+            return checkpoints.load_quantized(path, dtype=cfg.dtype)
         if os.path.isdir(path) and os.path.exists(os.path.join(path, "config.json")):
             from kukeon_tpu.models import hf_convert
 
+            if quantize:
+                return hf_convert.load_params_quantized(path, dtype=cfg.dtype)
             return hf_convert.load_params(path, dtype=cfg.dtype)
         import orbax.checkpoint as ocp
 

@@ -117,7 +117,24 @@ class ServingEngine:
         eos_ids: tuple[int, ...] = (),
         decode_chunk: int = 16,
         seed: int = 0,
+        int8_pallas: bool | None = None,
     ):
+        # int8_pallas=None -> auto: route quantized decode matmuls through
+        # the Pallas kernel on a single-chip TPU mesh (multi-chip meshes keep
+        # XLA's dequant dot, which GSPMD partitions; a pallas_call would
+        # force all-gathers of the sharded weights). Explicit True/False is
+        # authoritative either way — False must clear a flag already set on
+        # cfg, or a multi-chip engine handed a pallas-enabled cfg would
+        # all-gather full weights every layer.
+        if int8_pallas is None:
+            int8_pallas = cfg.int8_pallas or (
+                jax.default_backend() == "tpu"
+                and mesh is not None
+                and mesh.size == 1
+                and llama._is_q(params.get("layers", {}).get("wq"))
+            )
+        if cfg.int8_pallas != int8_pallas:
+            cfg = dataclasses.replace(cfg, int8_pallas=int8_pallas)
         self.cfg = cfg
         self.mesh = mesh
         self.num_slots = num_slots

@@ -1,0 +1,217 @@
+"""Checkpoint tooling: HF-layout synthesis, streaming int8 load, quantized
+checkpoint save/load, and the int8_pallas flag plumbing (VERDICT r3 items
+1 & 4)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kukeon_tpu.models import checkpoints, hf_convert, llama
+
+
+def _tiny_cfg():
+    return llama.llama_tiny()
+
+
+class TestSynthesize:
+    def test_hub_layout_and_loadable(self, tmp_path):
+        cfg = _tiny_cfg()
+        path = checkpoints.synthesize_hf_checkpoint(
+            str(tmp_path), cfg, dtype=np.float32, tokenizer=False
+        )
+        assert (tmp_path / "config.json").exists()
+        assert (tmp_path / "model.safetensors.index.json").exists()
+        index = json.loads((tmp_path / "model.safetensors.index.json").read_text())
+        # canonical n-of-m shard names
+        for shard in index["weight_map"].values():
+            assert shard.startswith("model-000")
+        params, loaded = hf_convert.load_params(path, dtype=jnp.float32)
+        assert loaded.hidden_size == cfg.hidden_size
+        tokens = jnp.array([[1, 2, 3]], jnp.int32)
+        pos = jnp.arange(3, dtype=jnp.int32)[None, :]
+        logits, _ = llama.forward(params, loaded, tokens, pos)
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_idempotent(self, tmp_path):
+        cfg = _tiny_cfg()
+        checkpoints.synthesize_hf_checkpoint(str(tmp_path), cfg,
+                                             dtype=np.float32, tokenizer=False)
+        before = sorted(p.name for p in tmp_path.iterdir())
+        checkpoints.synthesize_hf_checkpoint(str(tmp_path), cfg,
+                                             dtype=np.float32, tokenizer=False)
+        assert sorted(p.name for p in tmp_path.iterdir()) == before
+
+    def test_sharding_by_size(self, tmp_path):
+        cfg = _tiny_cfg()
+        checkpoints.synthesize_hf_checkpoint(
+            str(tmp_path), cfg, dtype=np.float32, tokenizer=False,
+            max_shard_bytes=256 * 1024,
+        )
+        index = json.loads((tmp_path / "model.safetensors.index.json").read_text())
+        assert len(set(index["weight_map"].values())) > 1
+        params, loaded = hf_convert.load_params(str(tmp_path), dtype=jnp.float32)
+        assert params["layers"]["wq"].shape[0] == loaded.num_layers
+
+    def test_tokenizer_json_real(self, tmp_path):
+        from kukeon_tpu.serving.tokenizer import HFTokenizer, load_tokenizer
+
+        checkpoints.write_tokenizer_json(str(tmp_path))
+        tok = load_tokenizer(str(tmp_path))
+        assert isinstance(tok, HFTokenizer)
+        ids = tok.encode("def main(argv):")
+        assert ids[0] == tok.bos_id
+        assert tok.decode(ids) == "def main(argv):"
+
+
+class TestStreamingQuantizedLoad:
+    def test_matches_load_then_quantize(self, tmp_path):
+        """load_params_quantized == quantize_params(load_params) leaf-wise."""
+        cfg = _tiny_cfg()
+        checkpoints.synthesize_hf_checkpoint(str(tmp_path), cfg,
+                                             dtype=np.float32, tokenizer=False)
+        qp_stream, cfg_s = hf_convert.load_params_quantized(str(tmp_path))
+        params, _ = hf_convert.load_params(str(tmp_path), dtype=jnp.float32)
+        qp_ref = llama.quantize_params(params)
+
+        np.testing.assert_array_equal(
+            np.asarray(qp_stream["layers"]["wq"]["q"]),
+            np.asarray(qp_ref["layers"]["wq"]["q"]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(qp_stream["layers"]["w_down"]["s"]),
+            np.asarray(qp_ref["layers"]["w_down"]["s"]), rtol=1e-6,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(qp_stream["embed"]["q"]), np.asarray(qp_ref["embed"]["q"])
+        )
+
+    def test_forward_runs_from_streamed_tree(self, tmp_path):
+        cfg = _tiny_cfg()
+        checkpoints.synthesize_hf_checkpoint(str(tmp_path), cfg,
+                                             dtype=np.float32, tokenizer=False)
+        qp, cfg2 = hf_convert.load_params_quantized(str(tmp_path))
+        cfg2 = dataclasses.replace(cfg2, dtype=jnp.float32)
+        qp = jax.tree.map(jnp.asarray, qp)
+        tokens = jnp.array([[1, 2, 3, 4]], jnp.int32)
+        pos = jnp.arange(4, dtype=jnp.int32)[None, :]
+        logits, _ = llama.forward(qp, cfg2, tokens, pos)
+        assert bool(jnp.isfinite(logits).all())
+
+
+class TestQuantizedCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        cfg = _tiny_cfg()
+        params = llama.init_params(jax.random.key(0), cfg)
+        qp = llama.quantize_params(params)
+        qdir = tmp_path / "quant"
+        checkpoints.save_quantized(str(qdir), jax.tree.map(np.asarray, qp), cfg)
+        assert checkpoints.is_quantized_checkpoint(str(qdir))
+
+        loaded, cfg2 = checkpoints.load_quantized(str(qdir), dtype=jnp.float32)
+        assert cfg2.vocab_size == cfg.vocab_size
+        np.testing.assert_array_equal(
+            loaded["layers"]["w_gate"]["q"], np.asarray(qp["layers"]["w_gate"]["q"])
+        )
+        # Serves identically to the in-memory quantized tree (greedy).
+        from kukeon_tpu.parallel import make_mesh
+        from kukeon_tpu.serving import SamplingParams, ServingEngine
+
+        mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+        sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+        prompt = np.array([3, 1, 4, 1, 5], np.int32)
+        out_mem = ServingEngine(cfg, qp, mesh, num_slots=2,
+                                max_seq_len=64).generate(prompt, sp)
+        out_disk = ServingEngine(cfg2, loaded, mesh, num_slots=2,
+                                 max_seq_len=64).generate(prompt, sp)
+        assert out_mem == out_disk
+
+    def test_not_quantized_dir(self, tmp_path):
+        assert not checkpoints.is_quantized_checkpoint(str(tmp_path))
+
+
+class TestServingCellLoaders:
+    def test_quantized_checkpoint_path(self, tmp_path):
+        """ServingCell must take the zero-work int8 path for quantized dirs."""
+        import dataclasses
+
+        from kukeon_tpu.runtime.serving_cell import ServingCell
+
+        cfg = dataclasses.replace(_tiny_cfg())
+        qp = llama.quantize_params(llama.init_params(jax.random.key(0), cfg))
+        qdir = tmp_path / "q"
+        checkpoints.save_quantized(str(qdir), jax.tree.map(np.asarray, qp), cfg)
+        cell = ServingCell("tiny", num_slots=2, max_seq_len=64,
+                           checkpoint=str(qdir), dtype=None)
+        out = cell.generate({"promptTokens": [3, 1, 4], "maxNewTokens": 4,
+                             "temperature": 0.0})
+        assert out["numTokens"] == 4
+
+    def test_hf_dir_int8_streams(self, tmp_path, monkeypatch):
+        """--dtype int8 + HF dir must stream-quantize, never materialize
+        the bf16 tree (the 8B-OOM path the loaders exist to avoid)."""
+        from kukeon_tpu.models import hf_convert
+        from kukeon_tpu.runtime.serving_cell import ServingCell
+
+        checkpoints.synthesize_hf_checkpoint(str(tmp_path), _tiny_cfg(),
+                                             dtype=np.float32, tokenizer=False)
+
+        def boom(*a, **k):
+            raise AssertionError("full bf16 load_params used on int8 path")
+
+        monkeypatch.setattr(hf_convert, "load_params", boom)
+        cell = ServingCell("tiny", num_slots=2, max_seq_len=64,
+                           checkpoint=str(tmp_path), dtype="int8")
+        out = cell.generate({"promptTokens": [3, 1, 4], "maxNewTokens": 4,
+                             "temperature": 0.0})
+        assert out["numTokens"] == 4
+
+
+class TestInt8PallasFlag:
+    def test_flag_plumbing_cpu_fallback(self):
+        """int8_pallas=True must be a no-op numerically (CPU backend routes
+        through the XLA fallback inside int8_matmul)."""
+        cfg = _tiny_cfg()
+        qp = llama.quantize_params(llama.init_params(jax.random.key(0), cfg))
+        cfg8 = dataclasses.replace(cfg, int8_pallas=True)
+        B = 2
+        cache = llama.KVCache.create(cfg, B, 32)
+        cache8 = llama.KVCache.create(cfg, B, 32)
+        prompt = jax.random.randint(jax.random.key(1), (B, 8), 0, cfg.vocab_size)
+        pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32)[None, :], (B, 8))
+        _, cache = llama.forward(qp, cfg, prompt, pos, cache=cache)
+        _, cache8 = llama.forward(qp, cfg8, prompt, pos, cache=cache8)
+        t = jnp.array([[5], [7]], jnp.int32)
+        lg, _ = llama.forward(qp, cfg, t, cache.lengths[:, None], cache=cache)
+        lg8, _ = llama.forward(qp, cfg8, t, cache8.lengths[:, None], cache=cache8)
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(lg8),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_engine_auto_flag_off_on_cpu(self):
+        from kukeon_tpu.parallel import make_mesh
+        from kukeon_tpu.serving import ServingEngine
+
+        cfg = _tiny_cfg()
+        qp = llama.quantize_params(llama.init_params(jax.random.key(0), cfg))
+        mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+        eng = ServingEngine(cfg, qp, mesh, num_slots=2, max_seq_len=64)
+        assert eng.cfg.int8_pallas is False   # cpu backend -> auto stays off
+
+    def test_engine_explicit_false_clears_cfg_flag(self):
+        """int8_pallas=False must override a flag already set on cfg (a
+        multi-chip engine handed a pallas cfg would all-gather weights)."""
+        import dataclasses
+
+        from kukeon_tpu.parallel import make_mesh
+        from kukeon_tpu.serving import ServingEngine
+
+        cfg = dataclasses.replace(_tiny_cfg(), int8_pallas=True)
+        qp = llama.quantize_params(llama.init_params(jax.random.key(0), cfg))
+        mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+        eng = ServingEngine(cfg, qp, mesh, num_slots=2, max_seq_len=64,
+                            int8_pallas=False)
+        assert eng.cfg.int8_pallas is False
