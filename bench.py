@@ -1,65 +1,170 @@
-"""Benchmark: aggregate agent-serving decode throughput (tok/s).
+"""Benchmark: flagship 8B-class agent serving + Session cold-start.
 
-Mirrors the BASELINE.json north-star shape — N concurrent coding-agent
-sessions decoding against one shared model — scaled to the chips actually
-present. The 8-chip target is 1500 aggregate tok/s for Llama-3-8B on v5e-8;
-``vs_baseline`` compares against the pro-rata per-chip share of that target
-(1500 * n_chips / 8).
+BASELINE.json north star: 4 concurrent coding-agent sessions on a v5e-8
+serving Llama-3-8B at >=1500 aggregate tok/s, p50 Session cold-start <90s.
+This harness measures both, scaled to the chips actually present, and is
+iso-model: on TPU the served model IS the 8B shape (int8 weights-only
+quantization — ~8 GB — fits a single 16 GB v5e chip), so ``vs_baseline``
+compares like with like (8B throughput vs the pro-rata 8B target,
+1500 * n_chips / 8).
 
-Round-1 note: a single v5e chip (16 GB HBM) cannot hold Llama-3-8B bf16, so
-the single-chip benchmark serves the Llama-3.2-1B shape; the JSON labels the
-model so the number is not mistaken for an 8B measurement.
+Pipeline (TPU):
+  1. synthesize an 8B HF-hub-layout checkpoint (sharded safetensors +
+     config.json + tokenizer.json) — no network egress, so weights are
+     random at the real shapes; every serving byte still flows through the
+     exact code a downloaded checkpoint would (models/checkpoints.py);
+  2. stream-quantize it to the kukeon int8 format (cached);
+  3. serve it through ServingEngine (continuous batching, chunked decode)
+     with the checkpoint's real BPE tokenizer — measured in a subprocess so
+     the orchestrator never holds the chip (libtpu is single-process);
+  4. cold-start: 3x [fresh daemon -> `kuke apply` model-cell manifest ->
+     first /v1/health 200], p50 (VERDICT r2/r3 item 2). The health endpoint
+     answers only after weight load + compile warmup, so this is the full
+     boot cost an agent session would see.
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N, ...}
+Prints exactly ONE JSON line:
+  {"metric", "value" (tok/s), "unit", "vs_baseline", "trials",
+   "cold_start": {"p50_s", "target_s", "runs_s"}}
+
+CPU hosts run a tiny-model smoke of the same two phases.
 """
 
+import argparse
 import json
+import os
+import subprocess
+import sys
+import tempfile
 import time
+import urllib.request
+import uuid
 
-import numpy as np
+REPO = os.path.dirname(os.path.abspath(__file__))
+CACHE = os.environ.get("KUKEON_BENCH_CACHE", "/tmp/kukeon-bench")
+COLD_START_TARGET_S = 90.0
 
 
-def main():
+def _log(msg: str) -> None:
+    print(f"bench: {msg}", file=sys.stderr, flush=True)
+
+
+def subprocess_env() -> dict:
+    """Env for child processes. When the caller forces JAX_PLATFORMS=cpu,
+    strip TPU-plugin sitecustomize dirs from PYTHONPATH — such plugins
+    pre-import jax and would ignore the env var (see tests/conftest.py) —
+    and put the repo on the path."""
+    env = dict(os.environ)
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    if env.get("JAX_PLATFORMS") == "cpu":
+        parts = [p for p in parts if "axon" not in p]
+    if REPO not in parts:
+        parts.insert(0, REPO)
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    return env
+
+
+def detect_backend() -> tuple[str, int]:
+    """Backend + device count, probed in a throwaway subprocess so this
+    orchestrator process never initializes (and then holds) the TPU."""
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(jax.default_backend(), len(jax.devices()))"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env=subprocess_env(),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"backend probe failed:\n{out.stderr[-2000:]}")
+    backend, n = out.stdout.split()[-2:]
+    return backend, int(n)
+
+
+# --- checkpoint prep (host-only, no TPU) -------------------------------------
+
+def ensure_quantized_8b() -> str:
+    """Synthesize the 8B HF checkpoint and its int8 quantized form (both
+    cached under CACHE); returns the quantized checkpoint dir."""
+    sys.path.insert(0, REPO)
+    from kukeon_tpu.models import checkpoints, hf_convert, llama
+
+    qdir = os.path.join(CACHE, "llama3-8b-int8")
+    if checkpoints.is_quantized_checkpoint(qdir):
+        return qdir
+    hf_dir = os.path.join(CACHE, "llama3-8b-hf")
+    cfg = llama.llama3_8b()
+    t0 = time.monotonic()
+    _log("synthesizing 8B HF checkpoint (one-time, ~16 GB)...")
+    checkpoints.synthesize_hf_checkpoint(hf_dir, cfg)
+    _log(f"synthesized in {time.monotonic() - t0:.0f}s; stream-quantizing to int8...")
+    t0 = time.monotonic()
+    params, cfg = hf_convert.load_params_quantized(hf_dir)
+    checkpoints.save_quantized(qdir, params, cfg)
+    # The serving cell wants the tokenizer next to the weights it loads.
+    import shutil
+
+    shutil.copy(os.path.join(hf_dir, "tokenizer.json"),
+                os.path.join(qdir, "tokenizer.json"))
+    _log(f"quantized in {time.monotonic() - t0:.0f}s -> {qdir}")
+    return qdir
+
+
+# --- serve phase (runs in its own process; owns the chip) ---------------------
+
+def phase_serve(args) -> None:
+    import numpy as np
+
+    sys.path.insert(0, REPO)
     import jax
 
-    from kukeon_tpu.models import llama
-    from kukeon_tpu.parallel import make_mesh, auto_mesh_shape
+    from kukeon_tpu.models import checkpoints, llama
+    from kukeon_tpu.parallel import auto_mesh_shape, make_mesh
     from kukeon_tpu.serving import SamplingParams, ServingEngine
+    from kukeon_tpu.serving.tokenizer import load_tokenizer
 
     backend = jax.default_backend()
     n_chips = len(jax.devices())
-
-    if backend == "cpu":
-        cfg = llama.llama_tiny()
-        sessions, prompt_len, new_tokens, max_seq = 2, 32, 16, 128
-        model_name = "tiny (cpu smoke)"
-    else:
-        cfg = llama.llama3_1b()
-        sessions, prompt_len, new_tokens, max_seq = 4, 128, 128, 1024
-        model_name = "llama3.2-1b-shape"
-
     shape = auto_mesh_shape(n_chips)
     mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
 
-    params = llama.init_params(jax.random.key(0), cfg)
+    if args.checkpoint:
+        params, cfg = checkpoints.load_quantized(args.checkpoint)
+        tokenizer = load_tokenizer(args.checkpoint)
+        model_name = "llama3-8b (int8)"
+        sessions, prompt_len, new_tokens, max_seq = 4, 128, 128, 1024
+    else:
+        cfg = llama.llama_tiny()
+        params = llama.init_params(jax.random.key(0), cfg)
+        tokenizer = None
+        model_name = "tiny (cpu smoke)"
+        sessions, prompt_len, new_tokens, max_seq = 2, 32, 16, 128
+
     engine = ServingEngine(
-        cfg, params, mesh, num_slots=sessions, max_seq_len=max_seq
+        cfg, params, mesh, num_slots=sessions, max_seq_len=max_seq,
+        decode_chunk=args.decode_chunk,
     )
 
     rng = np.random.default_rng(0)
-    prompts = [
-        rng.integers(1, cfg.vocab_size, size=prompt_len).astype(np.int32)
-        for _ in range(sessions)
-    ]
+    if tokenizer is not None:
+        # Real-tokenizer prompts: encode an agent-ish request, tile to the
+        # measured prompt length.
+        base = tokenizer.encode(
+            "You are a coding agent. Read the build failure below and "
+            "produce a minimal patch.\n\ndef main(argv):\n    return run(argv)\n"
+        )
+        prompts = []
+        for i in range(sessions):
+            ids = (base * (prompt_len // len(base) + 1))[:prompt_len]
+            prompts.append(np.asarray(ids, np.int32))
+    else:
+        prompts = [
+            rng.integers(1, cfg.vocab_size, size=prompt_len).astype(np.int32)
+            for _ in range(sessions)
+        ]
     sp = SamplingParams(max_new_tokens=new_tokens)
 
-    # Warmup: compile prefill (same bucket as the measured prompts), insert,
-    # and the decode-chunk programs.
     engine.warmup(prompt_len, sp)
+    _log("warmup done; measuring...")
 
-    # The chip link (tunnel) has high latency jitter; a single short run can
-    # swing +-30%. Measure several trials and report the median.
+    # The chip link can jitter; median of several trials.
     trials = 1 if backend == "cpu" else 3
     rates = []
     for _ in range(trials):
@@ -71,16 +176,159 @@ def main():
         total_tokens = sum(len(r.generated) for r in reqs)
         rates.append(total_tokens / dt)
     rates.sort()
-    toks_per_s = rates[len(rates) // 2]
+    print(json.dumps({
+        "backend": backend,
+        "n_chips": n_chips,
+        "model": model_name,
+        "sessions": sessions,
+        "tok_per_s": rates[len(rates) // 2],
+        "trials": [round(r, 1) for r in rates],
+    }), flush=True)
 
-    baseline_share = 1500.0 * n_chips / 8.0
+
+# --- cold-start phase ---------------------------------------------------------
+
+def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
+                        chips: str) -> list[float]:
+    """N x [fresh daemon -> kuke apply model-cell manifest -> first
+    /v1/health 200]. The daemon and model server are real subprocesses on
+    the real CLI path (VERDICT item 2: 'time kuke apply of a model-cell
+    manifest -> first /v1/health 200')."""
+    cli = [sys.executable, "-m", "kukeon_tpu.runtime.cli"]
+    times: list[float] = []
+    for run in range(runs):
+        run_path = tempfile.mkdtemp(prefix="kuke-bench-")
+        socket_path = f"/tmp/kuked-bench-{uuid.uuid4().hex[:8]}.sock"
+        port = 9600 + run
+        env = subprocess_env()
+        env.update({
+            "KUKEON_TPU_CHIPS": chips,
+            "KUKEOND_RECONCILE_INTERVAL": "1.0",
+        })
+        # hostNetwork: the bench host's chip is reachable only through the
+        # host loopback (tunneled/emulated TPU runtime plane) and the timer
+        # polls 127.0.0.1; the in-policy model-cell path is e2e-covered in
+        # tests/test_netpolicy_e2e.py.
+        manifest = (
+            "apiVersion: kukeon.io/v1beta1\n"
+            "kind: Cell\n"
+            "metadata: {name: llm}\n"
+            "spec:\n"
+            f"  model: {{model: {model}, chips: 1, port: {port}, numSlots: 4"
+            + (f", checkpoint: {checkpoint}" if checkpoint else "")
+            + ", maxSeqLen: 1024, hostNetwork: true}\n"
+        )
+        daemon = subprocess.Popen(
+            cli + ["daemon", "serve", "--run-path", run_path,
+                   "--socket", socket_path],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        try:
+            deadline = time.monotonic() + 15
+            while not os.path.exists(socket_path):
+                if time.monotonic() > deadline:
+                    raise RuntimeError("daemon socket did not appear")
+                time.sleep(0.05)
+            t0 = time.monotonic()
+            subprocess.run(
+                cli + ["--socket", socket_path, "--run-path", run_path,
+                       "apply", "-f", "-"],
+                input=manifest, text=True, env=env, check=True,
+                capture_output=True, timeout=120,
+            )
+            health = f"http://127.0.0.1:{port}/v1/health"
+            deadline = time.monotonic() + 600
+            while True:
+                try:
+                    with urllib.request.urlopen(health, timeout=2) as r:
+                        if r.status == 200:
+                            break
+                except OSError:
+                    pass
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"model cell not healthy in 600s (run {run})")
+                time.sleep(0.25)
+            dt = time.monotonic() - t0
+            times.append(dt)
+            _log(f"cold start run {run}: {dt:.1f}s")
+            subprocess.run(
+                cli + ["--socket", socket_path, "--run-path", run_path,
+                       "delete", "cell", "llm", "--force"],
+                env=env, capture_output=True, timeout=120,
+            )
+        finally:
+            daemon.terminate()
+            try:
+                daemon.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                daemon.kill()
+            import shutil
+
+            shutil.rmtree(run_path, ignore_errors=True)
+            if os.path.exists(socket_path):
+                os.unlink(socket_path)
+    return times
+
+
+# --- orchestrator -------------------------------------------------------------
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--phase", default="all", choices=["all", "serve"])
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--decode-chunk", type=int,
+                    default=int(os.environ.get("KUKEON_BENCH_CHUNK", "16")))
+    args = ap.parse_args()
+
+    if args.phase == "serve":
+        phase_serve(args)
+        return
+
+    backend, n_chips = detect_backend()
+    _log(f"backend={backend} n_chips={n_chips}")
+
+    if backend == "cpu":
+        qdir = None
+        cold_model, cold_runs = "tiny", 1
+    else:
+        qdir = ensure_quantized_8b()
+        cold_model, cold_runs = "llama3-8b", 3
+
+    # Serve phase in its own process (exits -> releases the chip for the
+    # cold-start daemons).
+    serve_cmd = [sys.executable, os.path.abspath(__file__), "--phase", "serve",
+                 "--decode-chunk", str(args.decode_chunk)]
+    if qdir:
+        serve_cmd += ["--checkpoint", qdir]
+    out = subprocess.run(serve_cmd, capture_output=True, text=True,
+                         timeout=3600, cwd=REPO, env=subprocess_env())
+    if out.returncode != 0:
+        raise RuntimeError(f"serve phase failed:\n{out.stderr[-4000:]}")
+    sys.stderr.write(out.stderr)
+    serve = json.loads(out.stdout.strip().splitlines()[-1])
+
+    cold_runs_s = measure_cold_starts(
+        cold_model, qdir, cold_runs,
+        chips=os.environ.get("KUKEON_TPU_CHIPS", "0"),
+    )
+    cold_runs_s.sort()
+    p50 = cold_runs_s[len(cold_runs_s) // 2]
+
+    baseline_share = 1500.0 * serve["n_chips"] / 8.0
     print(json.dumps({
         "metric": "aggregate decode tok/s, %d concurrent sessions, %s, %d chip(s) [%s]"
-                  % (sessions, model_name, n_chips, backend),
-        "value": round(toks_per_s, 2),
+                  % (serve["sessions"], serve["model"], serve["n_chips"],
+                     serve["backend"]),
+        "value": round(serve["tok_per_s"], 2),
         "unit": "tok/s",
-        "vs_baseline": round(toks_per_s / baseline_share, 4),
-        "trials": [round(r, 1) for r in rates],
+        "vs_baseline": round(serve["tok_per_s"] / baseline_share, 4),
+        "trials": serve["trials"],
+        "cold_start": {
+            "p50_s": round(p50, 1),
+            "target_s": COLD_START_TARGET_S,
+            "runs_s": [round(t, 1) for t in cold_runs_s],
+            "model": cold_model,
+        },
     }))
 
 

@@ -165,6 +165,14 @@ class ModelSpec:
     max_seq_len: int | None = None
     checkpoint: str | None = None    # orbax checkpoint dir; random-init if None
     dtype: str | None = None
+    # Model cells live INSIDE the space network by default: the server binds
+    # the cell's bridge IP, in-space agent cells reach it there, and the
+    # space's default-deny egress governs its traffic (BASELINE config 4).
+    # hostNetwork: true is the spec-visible opt-out for hosts whose TPU
+    # runtime plane needs host networking (multi-host pod slices, emulated
+    # chips behind a loopback tunnel) — it exempts the cell from the space
+    # egress policy, so it must be an explicit manifest decision.
+    host_network: bool = False
 
 
 # --- cell / hierarchy ----------------------------------------------------

@@ -11,6 +11,7 @@ import yaml
 from kukeon_tpu.runtime import naming
 from kukeon_tpu.runtime.api import types as t
 from kukeon_tpu.runtime.api.wire import from_wire
+from kukeon_tpu.runtime.apply.validate import validate_spec
 from kukeon_tpu.runtime.errors import InvalidArgument
 
 # Scope requirements per kind: which metadata fields must / may be set.
@@ -92,58 +93,25 @@ def validate_document(doc: t.Document, context: str = "") -> None:
         _forbid_scope(md, ctx, "realm", "space", "stack", "cell")
     elif doc.kind == t.KIND_SPACE:
         _forbid_scope(md, ctx, "space", "stack", "cell")
+        validate_spec(doc.kind, doc.spec, ctx)
     elif doc.kind == t.KIND_STACK:
         _forbid_scope(md, ctx, "stack", "cell")
     elif doc.kind in (t.KIND_CELL, t.KIND_CONTAINER):
         _forbid_scope(md, ctx, "cell")
-        if doc.kind == t.KIND_CELL:
-            _validate_cell_spec(doc.spec, ctx)
+        validate_spec(doc.kind, doc.spec, ctx)
     elif doc.kind in _SCOPED_KINDS:
         if md.cell is not None:
             raise InvalidArgument(f"{ctx}: {doc.kind} cannot be cell-scoped")
         # stack scope requires space; space requires realm (when given).
         if md.stack is not None and md.space is None:
             raise InvalidArgument(f"{ctx}: stack scope requires space")
-        if doc.kind == t.KIND_VOLUME:
-            if doc.spec.reclaim_policy not in ("retain", "delete"):
-                raise InvalidArgument(
-                    f"{ctx}: reclaimPolicy must be retain|delete, got {doc.spec.reclaim_policy!r}"
-                )
-        if doc.kind == t.KIND_CELL_CONFIG and not doc.spec.blueprint:
-            raise InvalidArgument(f"{ctx}: CellConfig.spec.blueprint is required")
+        validate_spec(doc.kind, doc.spec, ctx)
 
 
 def _forbid_scope(md: t.Metadata, ctx: str, *fields: str) -> None:
     for f in fields:
         if getattr(md, f) is not None:
             raise InvalidArgument(f"{ctx}: metadata.{f} is not allowed for this kind")
-
-
-def _validate_cell_spec(spec: t.CellSpec, ctx: str) -> None:
-    if not spec.containers and spec.model is None:
-        raise InvalidArgument(f"{ctx}: cell needs containers or a model spec")
-    seen = set()
-    for c in spec.containers:
-        naming.validate_name(c.name, "container name")
-        if c.name in seen:
-            raise InvalidArgument(f"{ctx}: duplicate container name {c.name!r}")
-        seen.add(c.name)
-        if not c.command and not c.image:
-            raise InvalidArgument(
-                f"{ctx}: container {c.name!r} needs a command (process backend) or image"
-            )
-        if c.restart_policy.policy not in ("always", "on-failure", "never"):
-            raise InvalidArgument(
-                f"{ctx}: container {c.name!r}: restartPolicy.policy must be "
-                f"always|on-failure|never"
-            )
-        if c.resources.tpu_chips is not None and c.resources.tpu_chips < 0:
-            raise InvalidArgument(f"{ctx}: container {c.name!r}: tpuChips must be >= 0")
-    if spec.model is not None:
-        if spec.model.chips < 1:
-            raise InvalidArgument(f"{ctx}: model.chips must be >= 1")
-        if not spec.model.model:
-            raise InvalidArgument(f"{ctx}: model.model is required")
 
 
 def sort_documents(docs: list[t.Document], reverse: bool = False) -> list[t.Document]:

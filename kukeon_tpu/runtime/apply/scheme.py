@@ -12,6 +12,7 @@ import dataclasses
 
 from kukeon_tpu.runtime import consts
 from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.apply import validate
 from kukeon_tpu.runtime.errors import InvalidArgument
 
 
@@ -35,6 +36,10 @@ def normalize_cell(doc: t.Document, space_defaults: t.ContainerSpec | None = Non
         _merge_container_defaults(c, space_defaults) for c in spec.containers
     ]
     spec = dataclasses.replace(spec, containers=containers)
+    # Deep-validate the MERGED spec: the RPC create path reaches normalize
+    # without going through the parser, and space defaults could in theory
+    # merge an invalid value in (reference: apischeme validates post-merge).
+    validate.validate_cell(spec, f"Cell/{md.name}")
     return dataclasses.replace(doc, metadata=md, spec=spec)
 
 
@@ -72,6 +77,7 @@ def normalize(doc: t.Document) -> t.Document:
     if doc.kind == t.KIND_REALM:
         return doc
     if doc.kind == t.KIND_SPACE:
+        validate.validate_space(doc.spec, f"Space/{doc.metadata.name}")
         return dataclasses.replace(doc, metadata=default_scope(doc.metadata, need_space=False, need_stack=False))
     if doc.kind == t.KIND_STACK:
         return dataclasses.replace(doc, metadata=default_scope(doc.metadata, need_stack=False))

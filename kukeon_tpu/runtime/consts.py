@@ -40,6 +40,10 @@ CONFIGS_DIR = "configs"
 VOLUMES_DIR = "volumes"
 
 INSTANCE_FILE = "instance.json"
+# Host-port claims by host-network cells (runner enforces uniqueness).
+HOST_PORTS_FILE = "host-ports.json"
+# In-cell mount point for the setup-status report (repos staging).
+SETUP_STATUS_MOUNT = "/run/kukeon/setup-status.json"
 
 # Label keys (team-prune and provenance; reference: *.kukeon.io labels).
 LABEL_TEAM = "kukeon.io/team"

@@ -412,6 +412,9 @@ class Controller:
         old.labels = new_rec.labels
         old.provenance = new_rec.provenance
         old.generation += 1
+        # Ports are a compatible field, so the host-port claims must follow
+        # the update: re-claim (rejecting on conflict) and drop stale claims.
+        self.runner.claim_host_ports(old)
         self.store.write_cell(old)
         return "updated"
 

@@ -48,9 +48,23 @@ class Provenance:
 
 
 @dataclass
+class SetupStatus:
+    """Per-repo pre-start staging state (reference: the typed payloads
+    kuketty reports to attach clients, internal/kuketty/setupstatus)."""
+
+    container: str = ""
+    kind: str = "repo"
+    url: str = ""
+    path: str = ""
+    state: str = "pending"           # pending | cloning | ready | failed
+    error: str | None = None
+
+
+@dataclass
 class CellStatus:
     phase: str = PENDING
     reason: str | None = None
+    setup: list[SetupStatus] = field(default_factory=list)
     containers: list[ContainerStatus] = field(default_factory=list)
     observed_generation: int = 0
     tpu_chips: list[int] = field(default_factory=list)   # chips granted

@@ -129,6 +129,19 @@ class NetworkManager:
         # timeouts for nothing.
         resolver = self.resolver if self.enforcing else _null_resolver
         policy = resolve_policy(realm, space, spec.network, resolver=resolver)
+        # Intra-space traffic is always allowed: cells of one space reach
+        # each other (agent cells -> their model cell) even under
+        # default-deny, which governs what LEAVES the space. Hosts with
+        # br_netfilter enabled push bridged (same-bridge) frames through
+        # FORWARD, so without this rule a deny space would sever its own
+        # cells from each other. Cross-space stays denied: the dispatch
+        # matches the source bridge, and another space's subnet is not
+        # covered by this rule.
+        from kukeon_tpu.runtime.net.netpolicy import ResolvedRule
+
+        policy.allow.insert(0, ResolvedRule(
+            cidr=subnet, original_host="intra-space",
+        ))
         policy.allow.extend(
             slice_mesh_rules(self.slice_topology, resolver=resolver)
         )

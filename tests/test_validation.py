@@ -1,0 +1,232 @@
+"""Table-driven invalid-manifest suite: every malformed spec must die at
+parse/normalize, never in the runner (VERDICT r2/r3 item: apischeme depth;
+reference: internal/apischeme/scheme.go:43-885, apply/parser.go:220-823)."""
+
+from __future__ import annotations
+
+import pytest
+
+from kukeon_tpu.runtime.apply import parser
+from kukeon_tpu.runtime.errors import InvalidArgument
+
+HEADER = "apiVersion: kukeon.io/v1beta1\n"
+
+
+def cell(spec_yaml: str, name: str = "c1") -> str:
+    return HEADER + f"kind: Cell\nmetadata: {{name: {name}}}\nspec:\n{spec_yaml}"
+
+
+INVALID = [
+    # --- envelope / scope ------------------------------------------------
+    ("bad-apiversion", "apiVersion: v2\nkind: Cell\nmetadata: {name: a}\nspec: {}",
+     "apiVersion"),
+    ("unknown-kind", HEADER + "kind: Pod\nmetadata: {name: a}\nspec: {}", "kind"),
+    ("unknown-top-field", HEADER + "kind: Cell\nmetadata: {name: a}\nstatus: {}\nspec:\n  containers: [{name: m, command: [sh]}]",
+     "top-level"),
+    ("unknown-spec-field", cell("  bogus: 1\n  containers: [{name: m, command: [sh]}]"),
+     "unknown field"),
+    ("bad-name", HEADER + "kind: Cell\nmetadata: {name: 'Bad Name!'}\nspec:\n  containers: [{name: m, command: [sh]}]",
+     "name"),
+    ("realm-scoped-realm", HEADER + "kind: Realm\nmetadata: {name: a, realm: b}\nspec: {}",
+     "not allowed"),
+    ("space-scoped-space", HEADER + "kind: Space\nmetadata: {name: a, space: b}\nspec: {}",
+     "not allowed"),
+    ("volume-cell-scope", HEADER + "kind: Volume\nmetadata: {name: v, cell: c}\nspec: {}",
+     "cell-scoped"),
+    ("stack-scope-needs-space", HEADER + "kind: Secret\nmetadata: {name: s, stack: st}\nspec:\n  data: {A: b}",
+     "requires space"),
+    # --- cell / container ------------------------------------------------
+    ("cell-empty", cell("  containers: []"), "containers or a model"),
+    ("container-no-command", cell("  containers: [{name: m}]"), "command"),
+    ("container-dup-name", cell(
+        "  containers:\n    - {name: m, command: [sh]}\n    - {name: m, command: [sh]}"),
+     "duplicate container"),
+    ("bad-env-name", cell(
+        "  containers: [{name: m, command: [sh], env: [{name: '1BAD', value: x}]}]"),
+     "env name"),
+    ("workdir-relative", cell(
+        "  containers: [{name: m, command: [sh], workdir: rel/path}]"), "absolute"),
+    ("bad-user", cell(
+        "  containers: [{name: m, command: [sh], user: 'not a user!'}]"), "user"),
+    ("port-zero", cell(
+        "  containers: [{name: m, command: [sh], ports: [{port: 0}]}]"), "range"),
+    ("port-huge", cell(
+        "  containers: [{name: m, command: [sh], ports: [{port: 70000}]}]"), "range"),
+    ("port-bad-proto", cell(
+        "  containers: [{name: m, command: [sh], ports: [{port: 80, protocol: sctp}]}]"),
+     "tcp|udp"),
+    ("port-dup-in-container", cell(
+        "  containers: [{name: m, command: [sh], ports: [{port: 80}, {port: 80}]}]"),
+     "duplicate port"),
+    ("port-dup-across-containers", cell(
+        "  containers:\n"
+        "    - {name: a, command: [sh], ports: [{port: 80}]}\n"
+        "    - {name: b, command: [sh], ports: [{port: 80}]}"),
+     "more than one container"),
+    ("tmpfs-unsupported", cell(
+        "  containers: [{name: m, command: [sh], volumes: [{path: /scratch, tmpfs: true, name: v}]}]"),
+     "tmpfs"),
+    ("volume-no-source", cell(
+        "  containers: [{name: m, command: [sh], volumes: [{path: /data}]}]"),
+     "exactly one"),
+    ("volume-two-sources", cell(
+        "  containers: [{name: m, command: [sh], volumes: [{name: v, hostPath: /x, path: /data}]}]"),
+     "exactly one"),
+    ("volume-relative-path", cell(
+        "  containers: [{name: m, command: [sh], volumes: [{name: v, path: data}]}]"),
+     "absolute"),
+    ("hostpath-relative", cell(
+        "  containers: [{name: m, command: [sh], volumes: [{hostPath: x, path: /d}]}]"),
+     "absolute"),
+    ("networks-unsupported", cell(
+        "  containers: [{name: m, command: [sh], networks: [other]}]"), "networks"),
+    ("bad-capability", cell(
+        "  containers: [{name: m, command: [sh], capabilities: ['cap sys admin']}]"),
+     "capability"),
+    ("device-not-dev", cell(
+        "  containers: [{name: m, command: [sh], devices: [/tmp/x]}]"), "/dev"),
+    ("bad-memory", cell(
+        "  containers: [{name: m, command: [sh], resources: {memory: lots}}]"),
+     "memory"),
+    ("cpu-zero", cell(
+        "  containers: [{name: m, command: [sh], resources: {cpu: 0}}]"), "cpu"),
+    ("pids-zero", cell(
+        "  containers: [{name: m, command: [sh], resources: {pids: 0}}]"), "pids"),
+    ("negative-chips", cell(
+        "  containers: [{name: m, command: [sh], resources: {tpuChips: -1}}]"),
+     "tpuChips"),
+    ("bad-secret-env", cell(
+        "  containers: [{name: m, command: [sh], secrets: [{name: s, env: 'no-dash'}]}]"),
+     "env name"),
+    ("secret-rel-path", cell(
+        "  containers: [{name: m, command: [sh], secrets: [{name: s, path: rel}]}]"),
+     "absolute"),
+    ("repo-no-url", cell(
+        "  containers: [{name: m, command: [sh], repos: [{path: /src}]}]"), "url"),
+    ("repo-no-path", cell(
+        "  containers: [{name: m, command: [sh], repos: [{url: 'https://x/y.git'}]}]"),
+     "path"),
+    ("repo-option-url", cell(
+        "  containers: [{name: m, command: [sh], repos: [{url: '--upload-pack=x', path: /src}]}]"),
+     "url"),
+    ("repo-nonurl", cell(
+        "  containers: [{name: m, command: [sh], repos: [{url: 'just-words', path: /src}]}]"),
+     "url"),
+    ("repo-option-ref", cell(
+        "  containers: [{name: m, command: [sh], repos: [{url: 'https://x/y.git', path: /src, ref: '--hard'}]}]"),
+     "ref"),
+    ("bad-restart-policy", cell(
+        "  containers: [{name: m, command: [sh], restartPolicy: {policy: sometimes}}]"),
+     "restartPolicy"),
+    ("negative-backoff", cell(
+        "  containers: [{name: m, command: [sh], restartPolicy: {policy: always, backoffSeconds: -1}}]"),
+     "backoffSeconds"),
+    ("negative-retries", cell(
+        "  containers: [{name: m, command: [sh], restartPolicy: {policy: always, maxRetries: -2}}]"),
+     "maxRetries"),
+    ("tty-without-attachable", cell(
+        "  containers: [{name: m, command: [sh], tty: {prompt: '$ '}}]"),
+     "attachable"),
+    ("tty-bad-loglevel", cell(
+        "  containers: [{name: m, command: [sh], attachable: true, tty: {logLevel: loud}}]"),
+     "logLevel"),
+    # --- model cells -----------------------------------------------------
+    ("model-no-name", cell("  model: {chips: 1}"), "model.model"),
+    ("model-zero-chips", cell("  model: {model: tiny, chips: 0}"), "chips"),
+    ("model-bad-port", cell("  model: {model: tiny, port: 99999}"), "range"),
+    ("model-zero-slots", cell("  model: {model: tiny, numSlots: 0}"), "numSlots"),
+    ("model-tiny-seq", cell("  model: {model: tiny, maxSeqLen: 4}"), "maxSeqLen"),
+    ("model-bad-dtype", cell("  model: {model: tiny, dtype: fp4}"), "dtype"),
+    ("model-port-collision", cell(
+        "  model: {model: tiny, port: 8080}\n"
+        "  containers: [{name: m, command: [sh], ports: [{port: 8080}]}]"),
+     "collides"),
+    # --- space networking ------------------------------------------------
+    ("egress-bad-default", HEADER + "kind: Space\nmetadata: {name: s}\nspec:\n  network: {egressDefault: maybe}",
+     "egressDefault"),
+    ("egress-host-and-cidr", HEADER + "kind: Space\nmetadata: {name: s}\nspec:\n  network:\n    egressAllow: [{host: x.com, cidr: 1.2.3.0/24}]",
+     "exactly one"),
+    ("egress-neither", HEADER + "kind: Space\nmetadata: {name: s}\nspec:\n  network:\n    egressAllow: [{ports: [80]}]",
+     "exactly one"),
+    ("egress-bad-cidr", HEADER + "kind: Space\nmetadata: {name: s}\nspec:\n  network:\n    egressAllow: [{cidr: 500.1.2.0/24}]",
+     "cidr"),
+    ("egress-bad-port", HEADER + "kind: Space\nmetadata: {name: s}\nspec:\n  network:\n    egressAllow: [{cidr: 1.2.3.0/24, ports: [0]}]",
+     "range"),
+    ("subnet-invalid", HEADER + "kind: Space\nmetadata: {name: s}\nspec:\n  subnet: not-a-subnet",
+     "subnet"),
+    ("subnet-too-small", HEADER + "kind: Space\nmetadata: {name: s}\nspec:\n  subnet: 10.1.0.0/31",
+     "too small"),
+    # --- secrets / volumes / blueprints / configs ------------------------
+    ("secret-empty", HEADER + "kind: Secret\nmetadata: {name: s}\nspec:\n  data: {}",
+     "empty"),
+    ("secret-bad-key", HEADER + "kind: Secret\nmetadata: {name: s}\nspec:\n  data: {'my key': v}",
+     "key"),
+    ("volume-bad-reclaim", HEADER + "kind: Volume\nmetadata: {name: v}\nspec:\n  reclaimPolicy: keep",
+     "reclaimPolicy"),
+    ("volume-bad-size", HEADER + "kind: Volume\nmetadata: {name: v}\nspec:\n  size: big",
+     "size"),
+    ("blueprint-dup-param", HEADER + "kind: CellBlueprint\nmetadata: {name: b}\nspec:\n"
+     "  params: [{name: p}, {name: p}]\n"
+     "  cell: {containers: [{name: m, command: [sh]}]}", "duplicate param"),
+    ("blueprint-required-default", HEADER + "kind: CellBlueprint\nmetadata: {name: b}\nspec:\n"
+     "  params: [{name: p, required: true, default: x}]\n"
+     "  cell: {containers: [{name: m, command: [sh]}]}", "required and defaulted"),
+    ("blueprint-bad-cell", HEADER + "kind: CellBlueprint\nmetadata: {name: b}\nspec:\n"
+     "  cell: {containers: []}", "containers or a model"),
+    ("config-no-blueprint", HEADER + "kind: CellConfig\nmetadata: {name: c}\nspec: {}",
+     "blueprint"),
+    ("config-dup-slot", HEADER + "kind: CellConfig\nmetadata: {name: c}\nspec:\n"
+     "  blueprint: b\n  secrets: [{slot: s, secret: a}, {slot: s, secret: b}]",
+     "duplicate secret slot"),
+    ("config-bad-value-key", HEADER + "kind: CellConfig\nmetadata: {name: c}\nspec:\n"
+     "  blueprint: b\n  values: {'bad key': v}", "value key"),
+]
+
+
+@pytest.mark.parametrize("case,manifest,msg", INVALID, ids=[c[0] for c in INVALID])
+def test_invalid_manifest_rejected_at_parse(case, manifest, msg):
+    with pytest.raises(InvalidArgument) as exc:
+        parser.parse_documents(manifest)
+    assert msg.lower() in str(exc.value).lower(), (
+        f"{case}: expected {msg!r} in error, got: {exc.value}"
+    )
+
+
+VALID = [
+    ("minimal-cell", cell("  containers: [{name: m, command: [sh]}]")),
+    ("full-container", cell(
+        "  containers:\n"
+        "    - name: m\n"
+        "      command: [python3, -c, 'print(1)']\n"
+        "      env: [{name: FOO, value: bar}]\n"
+        "      workdir: /work\n"
+        "      user: '1000:1000'\n"
+        "      ports: [{port: 8080}, {port: 53, protocol: udp}]\n"
+        "      volumes: [{name: data, path: /data, readOnly: true}]\n"
+        "      capabilities: [CAP_NET_BIND_SERVICE]\n"
+        "      devices: [/dev/accel0]\n"
+        "      resources: {memory: 2Gi, cpu: 1.5, pids: 256, tpuChips: 1}\n"
+        "      secrets: [{name: tok, env: TOKEN}]\n"
+        "      repos: [{url: 'https://x/y.git', path: /src, ref: main}]\n"
+        "      restartPolicy: {policy: on-failure, backoffSeconds: 2, maxRetries: 3}\n"
+        "      attachable: true\n"
+        "      tty: {prompt: '$ ', logLevel: debug}\n")),
+    ("model-cell", cell(
+        "  model: {model: llama3-8b, chips: 8, port: 9000, numSlots: 16,\n"
+        "          maxSeqLen: 4096, dtype: int8, hostNetwork: true}")),
+    ("space-deny", HEADER + "kind: Space\nmetadata: {name: s}\nspec:\n"
+     "  network:\n    egressDefault: deny\n"
+     "    egressAllow:\n      - {host: api.example.com, ports: [443]}\n"
+     "      - {cidr: 10.0.0.0/8}\n  subnet: 10.99.0.0/24"),
+    ("blueprint-with-params", HEADER + "kind: CellBlueprint\nmetadata: {name: b}\nspec:\n"
+     "  params: [{name: model, default: tiny}, {name: tok, required: true}]\n"
+     "  cell:\n    containers:\n"
+     "      - {name: m, command: [sh], env: [{name: MODEL, value: '${model}'}],\n"
+     "         resources: {memory: '${mem}'}}"),
+]
+
+
+@pytest.mark.parametrize("case,manifest", VALID, ids=[c[0] for c in VALID])
+def test_valid_manifest_accepted(case, manifest):
+    docs = parser.parse_documents(manifest)
+    assert docs
