@@ -51,7 +51,7 @@ def validate_container(c: t.ContainerSpec, ctx: str, *,
     def deferred(v) -> bool:
         """True when validation of this scalar belongs to materialization."""
         return in_blueprint and _has_param(v)
-    if not is_defaults:
+    if not is_defaults and not deferred(c.name):
         naming.validate_name(c.name, "container name")
         if not c.command and not c.image:
             raise InvalidArgument(
@@ -96,11 +96,11 @@ def validate_container(c: t.ContainerSpec, ctx: str, *,
             raise InvalidArgument(
                 f"{where}: volume mount needs exactly one of name|hostPath"
             )
-        if vm.host_path and not vm.host_path.startswith("/"):
+        if vm.host_path and not deferred(vm.host_path) and not vm.host_path.startswith("/"):
             raise InvalidArgument(f"{where}: hostPath must be absolute, got {vm.host_path!r}")
         if vm.path and not deferred(vm.path) and not vm.path.startswith("/"):
             raise InvalidArgument(f"{where}: volume path must be absolute, got {vm.path!r}")
-        if vm.name:
+        if vm.name and not deferred(vm.name):
             naming.validate_name(vm.name, "volume name")
 
     if c.networks:
@@ -116,6 +116,8 @@ def validate_container(c: t.ContainerSpec, ctx: str, *,
             raise InvalidArgument(f"{where}: invalid capability {cap!r}")
 
     for d in c.devices:
+        if deferred(d):
+            continue
         if not d.startswith("/dev/"):
             raise InvalidArgument(f"{where}: device must be a /dev path, got {d!r}")
 
@@ -133,10 +135,11 @@ def validate_container(c: t.ContainerSpec, ctx: str, *,
         raise InvalidArgument(f"{where}: tpuChips must be >= 0")
 
     for s in c.secrets:
-        naming.validate_name(s.name, "secret ref name")
-        if s.env is not None and not _ENV_NAME.match(s.env):
+        if not deferred(s.name):
+            naming.validate_name(s.name, "secret ref name")
+        if s.env is not None and not deferred(s.env) and not _ENV_NAME.match(s.env):
             raise InvalidArgument(f"{where}: secret env {s.env!r} is not a valid env name")
-        if s.path is not None and not s.path.startswith("/"):
+        if s.path is not None and not deferred(s.path) and not s.path.startswith("/"):
             raise InvalidArgument(f"{where}: secret path must be absolute, got {s.path!r}")
 
     for repo in c.repos:

@@ -199,6 +199,14 @@ def cmd_init(args):
     os.makedirs(run_path, exist_ok=True)
     local = LocalClient(run_path)     # bootstrap happens in the constructor
     del local
+    # System group so non-root clients can dial the 0660 socket
+    # (reference: internal/sysuser — kuke init provisions `kukeon`).
+    from kukeon_tpu.runtime import sysuser
+
+    gid = sysuser.ensure_group()
+    if gid is not None:
+        sysuser.chown_tree(run_path, gid)
+        print(f"Group: {sysuser.GROUP} (gid {gid})")
     print(f"Run path: {run_path}")
     print(f"Realm: {consts.DEFAULT_REALM}")
     print(f"System realm: {consts.SYSTEM_REALM}")
@@ -590,21 +598,60 @@ def cmd_status(args):
 
 
 def cmd_doctor(args):
-    """Host pre-flight checks (reference: kuke doctor / cgroupcheck)."""
+    """Host pre-flight checks (reference: kuke doctor / internal/cgroupcheck:
+    controller availability + delegation detail; all five native tools; the
+    isolation and egress-enforcement layers the security story depends on)."""
+    from kukeon_tpu.runtime import instance, sysuser
     from kukeon_tpu.runtime.cgroups import CgroupManager
     from kukeon_tpu.runtime.devices import discover_chips
 
     checks = []
     cg = CgroupManager()
-    checks.append(("cgroup-v2", "ok" if cg.available() else "unavailable (limits degrade)"))
+    if cg.available():
+        try:
+            with open(os.path.join(cg.root, "cgroup.controllers")) as f:
+                avail = set(f.read().split())
+            with open(os.path.join(cg.root, cg.base, "cgroup.subtree_control")) as f:
+                delegated = set(f.read().split())
+        except OSError:
+            avail, delegated = set(), set()
+        want = {"cpu", "memory", "pids"}
+        missing = want - delegated
+        detail = f"controllers={sorted(avail & want)} delegated={sorted(delegated & want)}"
+        if missing & avail:
+            detail += f" (NOT delegated: {sorted(missing & avail)})"
+        checks.append(("cgroup-v2", f"ok — {detail}"))
+    else:
+        checks.append(("cgroup-v2", "unavailable (limits degrade)"))
     chips = discover_chips()
     checks.append(("tpu-chips", f"{len(chips)} visible ({chips})" if chips else "none visible"))
     bin_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bin")
-    for b in ("kukepause", "kukeshim", "kuketty"):
+    for b in ("kukepause", "kukeshim", "kuketty", "kukecell", "kukenet"):
         ok = os.access(os.path.join(bin_dir, b), os.X_OK)
         checks.append((f"native/{b}", "ok" if ok else "MISSING (run `make -C native`)"))
+    # The two enforcement layers:
+    from kukeon_tpu.runtime.cells import namespace as nsb
+
+    checks.append(("isolation", "namespace sandbox (kukecell)" if nsb.available()
+                   else "process backend (no sandboxing — need root + kukecell)"))
+    from kukeon_tpu.runtime.net.kukenet import kukenet_usable
+    from kukeon_tpu.runtime.net.runners import ShellRunner
+
+    r = ShellRunner()
+    if r.available("iptables"):
+        checks.append(("net-enforce", "iptables CLI"))
+    elif kukenet_usable():
+        checks.append(("net-enforce", "kukenet (native xtables)"))
+    else:
+        checks.append(("net-enforce", "OFF (need root + iptables or kukenet)"))
+    gid = sysuser.group_gid()
+    checks.append(("group-kukeon", f"gid {gid}" if gid is not None
+                   else "absent (kuke init as root provisions it)"))
     run_path = _run_path(args)
     checks.append(("run-path", run_path + (" (exists)" if os.path.isdir(run_path) else " (not initialized — run `kuke init`)")))
+    pinned = instance.read(run_path)
+    if pinned:
+        checks.append(("instance", ", ".join(f"{k}={v}" for k, v in sorted(pinned.items()))))
     for name, result in checks:
         print(f"{name:<18} {result}")
     return 0

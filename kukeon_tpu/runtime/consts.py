@@ -44,6 +44,11 @@ INSTANCE_FILE = "instance.json"
 HOST_PORTS_FILE = "host-ports.json"
 # In-cell mount point for the setup-status report (repos staging).
 SETUP_STATUS_MOUNT = "/run/kukeon/setup-status.json"
+# Repo staging: per-clone budget, and how long a failed clone is cached
+# before the restart path retries it (keeps a dead remote from stalling
+# the reconcile tick for its full timeout on every restart).
+REPO_CLONE_TIMEOUT_S = 120
+REPO_RETRY_SECONDS = 300.0
 
 # Label keys (team-prune and provenance; reference: *.kukeon.io labels).
 LABEL_TEAM = "kukeon.io/team"

@@ -397,6 +397,17 @@ class DaemonServer:
                 "reconcileInterval": self.reconcile_interval_s,
             },
         )
+        # Instance pinning: refuse a run path bootstrapped under different
+        # settings (reference: internal/instance/instance.go:21-28).
+        from kukeon_tpu.runtime import instance
+
+        runner = self.ctl.runner
+        instance.pin_or_verify(self.run_path, {
+            "subnetPool": str(runner.netman.subnets.parent)
+            if runner.netman is not None else "",
+            "cgroupBase": runner.cgroups.base if runner.cgroups else "",
+            "backend": type(runner.backend).__name__,
+        })
         self.ctl.bootstrap()
         # Stale socket from a previous daemon: unlink after a probe.
         if os.path.exists(self.socket_path):
@@ -414,6 +425,12 @@ class DaemonServer:
         # Socket group access for non-root clients (reference: SocketGID,
         # server.go:42-116 — chown root:kukeon so group members can dial).
         gid = self.settings.get("KUKEOND_SOCKET_GID")
+        if not gid:
+            # Default to the provisioned `kukeon` group (sysuser) when
+            # present, like the reference's root:kukeon socket.
+            from kukeon_tpu.runtime import sysuser
+
+            gid = sysuser.group_gid()
         if gid:
             try:
                 os.chown(self.socket_path, -1, int(gid))

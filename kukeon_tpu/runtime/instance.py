@@ -1,0 +1,65 @@
+"""Instance pinning: a run path remembers how it was bootstrapped.
+
+Reference: internal/instance/instance.go:17-60 — `.kukeon-instance.json`
+pins the namespace-suffix + cgroup-root a run path was provisioned under,
+and the daemon refuses to start against a run path whose configuration has
+drifted (re-pointing a daemon at state bootstrapped under different
+settings corrupts subnets, cgroups, and backend assumptions silently).
+
+The TPU build's identity facts: the subnet pool the space subnets were
+carved from, the cgroup base the trees were created under, and the cell
+backend flavor (namespace sandboxes vs host processes — records written by
+one cannot be supervised by the other).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from kukeon_tpu.runtime import consts
+from kukeon_tpu.runtime.errors import FailedPrecondition
+
+
+def _path(run_path: str) -> str:
+    return os.path.join(run_path, consts.INSTANCE_FILE)
+
+
+def pin_or_verify(run_path: str, facts: dict[str, str]) -> None:
+    """First boot writes the facts (O_EXCL); later boots must match.
+
+    A mismatch names every drifted fact and how to recover (re-bootstrap a
+    fresh run path, or restore the original setting).
+    """
+    path = _path(run_path)
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+    except FileExistsError:
+        with open(path) as f:
+            pinned = json.load(f)
+        drift = {
+            k: (pinned.get(k), v)
+            for k, v in facts.items()
+            if k in pinned and pinned[k] != v
+        }
+        if drift:
+            detail = "; ".join(
+                f"{k}: bootstrapped with {old!r}, now {new!r}"
+                for k, (old, new) in sorted(drift.items())
+            )
+            raise FailedPrecondition(
+                f"run path {run_path} was bootstrapped under different "
+                f"settings ({detail}). Restore the original settings or "
+                f"bootstrap a fresh --run-path."
+            )
+        return
+    with os.fdopen(fd, "w") as f:
+        json.dump(facts, f, indent=1)
+
+
+def read(run_path: str) -> dict | None:
+    try:
+        with open(_path(run_path)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

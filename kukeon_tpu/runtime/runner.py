@@ -68,6 +68,8 @@ class Runner:
         self.netman = netman
         self._cell_locks: dict[tuple, threading.Lock] = {}
         self._locks_guard = threading.Lock()
+        # (owner, container, repo idx) -> last failed clone attempt time.
+        self._repo_failures: dict[tuple, float] = {}
 
     # --- locking (reference: runner/cell_lock.go) --------------------------
 
@@ -189,19 +191,25 @@ class Runner:
             self.store.read_stack(rec.realm, rec.space, rec.stack)
             self.guard_disk_pressure(rec.spec.ignore_disk_pressure)
             self.claim_host_ports(rec)
-            self.store.ms.ensure_dir(
-                *self.store.cell_parts(rec.realm, rec.space, rec.stack, rec.name)
-            )
-            if self.cgroups:
-                self.cgroups.ensure(rec.realm, rec.space, rec.stack, rec.name)
-            rec.status = model.CellStatus(
-                phase=model.PENDING,
-                containers=[
-                    model.ContainerStatus(name=c.name)
-                    for c in self.cell_containers(rec)
-                ],
-            )
-            self.store.write_cell(rec)
+            try:
+                self.store.ms.ensure_dir(
+                    *self.store.cell_parts(rec.realm, rec.space, rec.stack, rec.name)
+                )
+                if self.cgroups:
+                    self.cgroups.ensure(rec.realm, rec.space, rec.stack, rec.name)
+                rec.status = model.CellStatus(
+                    phase=model.PENDING,
+                    containers=[
+                        model.ContainerStatus(name=c.name)
+                        for c in self.cell_containers(rec)
+                    ],
+                )
+                self.store.write_cell(rec)
+            except Exception:
+                # A failed create must not strand its port claims: the cell
+                # record does not exist, so no delete will ever release them.
+                self._release_host_ports(rec)
+                raise
             return rec
 
     def cell_containers(self, rec: model.CellRecord) -> list[t.ContainerSpec]:
@@ -310,12 +318,23 @@ class Runner:
     def _ensure_cell_network(self, rec: model.CellRecord) -> None:
         """Attach the cell's sandbox netns to its space bridge (idempotent;
         reference: CNI ADD on cell start, runner/start.go:474-560)."""
-        if not self.backend.isolated or self.netman is None:
+        if not self.backend.isolated:
             return
         containers = self.cell_containers(rec)
         if containers and all(c.host_network for c in containers):
             # Nothing will use the sandbox netns; don't burn a bridge IP or
             # publish an address nothing listens on.
+            return
+        if self.netman is None or not self.netman.enforcing:
+            # The sandbox netns exists but no bridge will ever reach it: a
+            # Ready cell with a server bound in a disconnected netns is a
+            # dead end that MUST be named in status (a silent no-IP cell is
+            # undebuggable; use hostNetwork or enable net enforcement).
+            rec.status.reason = (
+                "cell is network-isolated but net enforcement is off: no "
+                "bridge/IP will be attached (set hostNetwork: true or run "
+                "with root + iptables/kukenet)"
+            )
             return
         try:
             pid = self.backend.ensure_sandbox(self._cell_dir(rec), rec.name)
@@ -488,13 +507,24 @@ class Runner:
             rec.status.setup.append(st)
             base = os.path.basename(repo.path.rstrip("/")) or f"repo{i}"
             dest = os.path.join(rdir, f"{i}-{base}")
+            # Failure cache: clone runs under the cell lock, and the restart
+            # path re-enters here from the reconcile tick — a dead remote
+            # must not stall daemon-wide supervision for its full timeout on
+            # EVERY restart of a crash-looping sibling.
+            fail_key = (self._owner_key(rec), spec.name, i)
+            last = self._repo_failures.get(fail_key, 0.0)
+            if time.time() - last < consts.REPO_RETRY_SECONDS:
+                st.state = "failed"
+                st.error = "previous clone attempt failed; retry pending"
+                continue
             try:
                 if not os.path.isdir(os.path.join(dest, ".git")):
                     # `--`: a dash-prefixed url/dest must never parse as a
                     # git option (defense in depth; validate.py rejects them).
                     p = subprocess.run(
                         ["git", "clone", "--", repo.url, dest],
-                        capture_output=True, text=True, timeout=300,
+                        capture_output=True, text=True,
+                        timeout=consts.REPO_CLONE_TIMEOUT_S,
                     )
                     if p.returncode != 0:
                         raise RuntimeError(p.stderr.strip()[-500:])
@@ -506,9 +536,11 @@ class Runner:
                     if p.returncode != 0:
                         raise RuntimeError(p.stderr.strip()[-500:])
                 st.state = "ready"
+                self._repo_failures.pop(fail_key, None)
             except (RuntimeError, OSError, subprocess.TimeoutExpired) as e:
                 st.state = "failed"
                 st.error = str(e)
+                self._repo_failures[fail_key] = time.time()
                 continue
             key = f"KUKEON_REPO_{i}"
             if self.backend.isolated:

@@ -1,0 +1,63 @@
+"""System group provisioning: the `kukeon` group gates non-root access.
+
+Reference: internal/sysuser/sysuser.go (239 LoC) — `kuke init` provisions a
+system `kukeon` group and chowns the tree so group members can dial the
+daemon socket (mode 0660 root:kukeon) without being root.
+"""
+
+from __future__ import annotations
+
+import grp
+import logging
+import os
+import subprocess
+
+log = logging.getLogger("kukeon.sysuser")
+
+GROUP = "kukeon"
+
+
+def group_gid(name: str = GROUP) -> int | None:
+    try:
+        return grp.getgrnam(name).gr_gid
+    except KeyError:
+        return None
+
+
+def ensure_group(name: str = GROUP) -> int | None:
+    """Provision the system group (root only); returns its gid, or None when
+    it cannot exist (non-root, no groupadd)."""
+    gid = group_gid(name)
+    if gid is not None:
+        return gid
+    if os.geteuid() != 0:
+        return None
+    for argv in (["groupadd", "--system", name], ["addgroup", "--system", name]):
+        try:
+            p = subprocess.run(argv, capture_output=True, text=True, timeout=10)
+        except OSError:
+            continue
+        if p.returncode == 0:
+            return group_gid(name)
+    log.warning("could not provision group %r (no groupadd/addgroup)", name)
+    return None
+
+
+def chown_tree(run_path: str, gid: int) -> None:
+    """root:kukeon + group-traversable dirs so group members can reach the
+    socket and read statuses; secrets stay 0400 root-only (the per-file
+    modes set at staging win over the tree default)."""
+    for dirpath, _dirnames, filenames in os.walk(run_path):
+        try:
+            os.chown(dirpath, -1, gid)
+            os.chmod(dirpath, os.stat(dirpath).st_mode | 0o050)
+        except OSError:
+            continue
+        for fn in filenames:
+            p = os.path.join(dirpath, fn)
+            try:
+                if os.stat(p).st_mode & 0o077 == 0:
+                    continue   # explicitly locked-down file (secrets)
+                os.chown(p, -1, gid)
+            except OSError:
+                continue
