@@ -51,8 +51,10 @@ def validate_container(c: t.ContainerSpec, ctx: str, *,
     def deferred(v) -> bool:
         """True when validation of this scalar belongs to materialization."""
         return in_blueprint and _has_param(v)
-    if not is_defaults and not deferred(c.name):
-        naming.validate_name(c.name, "container name")
+    if not is_defaults:
+        if not deferred(c.name):
+            naming.validate_name(c.name, "container name")
+        # Structural, not format: applies even with a parameterized name.
         if not c.command and not c.image:
             raise InvalidArgument(
                 f"{where} needs a command (process backend) or image"

@@ -118,6 +118,13 @@ def cmd_image(args):
     elif sub == "load":
         m = c.call("LoadImage", tarPath=os.path.abspath(args.input), ref=args.ref)
         print(f"image/{m['name']}:{m['tag']}: loaded")
+    elif sub == "pull":
+        if not args.ref:
+            print("error: image pull needs a registry/repo[:tag] ref", file=sys.stderr)
+            return 2
+        m = c.call("PullImage", ref=args.ref,
+                   insecure=True if args.insecure else None)
+        print(f"image/{m['name']}:{m['tag']}: pulled")
     elif sub == "save":
         c.call("SaveImage", ref=args.ref, tarPath=os.path.abspath(args.output))
         print(f"image/{args.ref}: saved to {args.output}")
@@ -634,16 +641,23 @@ def cmd_doctor(args):
 
     checks.append(("isolation", "namespace sandbox (kukecell)" if nsb.available()
                    else "process backend (no sandboxing — need root + kukecell)"))
+    # Same predicate the daemon uses — the preflight must never claim
+    # enforcement the runtime would run without.
     from kukeon_tpu.runtime.net.kukenet import kukenet_usable
+    from kukeon_tpu.runtime.net.manager import _enforcement_enabled
     from kukeon_tpu.runtime.net.runners import ShellRunner
 
     r = ShellRunner()
-    if r.available("iptables"):
-        checks.append(("net-enforce", "iptables CLI"))
+    if not _enforcement_enabled(r):
+        checks.append(("net-enforce", "OFF (need root + ip + iptables/kukenet, "
+                       "or KUKEON_NET_ENFORCE=1)"))
+    elif r.available("iptables"):
+        checks.append(("net-enforce", "on (iptables CLI)"))
     elif kukenet_usable():
-        checks.append(("net-enforce", "kukenet (native xtables)"))
+        checks.append(("net-enforce", "on (kukenet, native xtables)"))
     else:
-        checks.append(("net-enforce", "OFF (need root + iptables or kukenet)"))
+        checks.append(("net-enforce", "forced on (KUKEON_NET_ENFORCE=1) but no "
+                       "enforcer binary — policies will fail"))
     gid = sysuser.group_gid()
     checks.append(("group-kukeon", f"gid {gid}" if gid is not None
                    else "absent (kuke init as root provisions it)"))
@@ -850,10 +864,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub_add("image")
     sp.add_argument("image_cmd",
-                    choices=["list", "get", "delete", "prune", "load", "save"])
+                    choices=["list", "get", "delete", "prune", "load", "save",
+                             "pull"])
     sp.add_argument("ref", nargs="?", default=None)
     sp.add_argument("-i", "--input", default=None, help="tarball to load")
     sp.add_argument("-o", "--output", default=None, help="tarball to save to")
+    sp.add_argument("--insecure", action="store_true",
+                    help="pull over plain HTTP (implied for localhost)")
 
     sp = sub_add("build")
     sp.add_argument("context", nargs="?", default=".")

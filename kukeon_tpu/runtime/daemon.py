@@ -300,6 +300,11 @@ class RPCService:
     def LoadImage(self, tarPath: str, ref: str) -> dict:
         return self._image_store().load_tar(tarPath, ref).to_json()
 
+    def PullImage(self, ref: str, insecure: bool | None = None) -> dict:
+        from kukeon_tpu.runtime import registry
+
+        return registry.pull(self._image_store(), ref, insecure=insecure).to_json()
+
     def SaveImage(self, ref: str, tarPath: str) -> None:
         self._image_store().save_tar(ref, tarPath)
 

@@ -412,27 +412,66 @@ class ImageBuilder:
 
     def build(self, kukefile_path: str, context_dir: str, tag: str,
               build_args: dict[str, str] | None = None) -> ImageManifest:
+        """Build, with Docker-style multi-stage support: ``FROM x AS name``
+        starts a new stage; ``COPY --from=<name|idx> src dst`` copies out of
+        an earlier stage's rootfs; only the LAST stage commits to the store
+        (builder stages are scratch space, as in BuildKit)."""
         with open(kukefile_path) as f:
             instrs = parse_kukefile(f.read(), origin=kukefile_path)
 
+        # Split into stages at each FROM (leading ARGs belong to stage 0).
+        stages: list[list[Instruction]] = []
+        current: list[Instruction] = []
+        for ins in instrs:
+            if ins.op == "FROM" and any(i.op == "FROM" for i in current):
+                stages.append(current)
+                current = []
+            current.append(ins)
+        stages.append(current)
+
         name, tag_ = split_ref(tag)
-        m = ImageManifest(name=name, tag=tag_)
         vars_ = dict(build_args or {})
-        staging = self.store.stage(m.ref)
+        stage_roots: dict[str, str] = {}
+        stage_manifests: dict[str, ImageManifest] = {}
+        stagings: list[str] = []
+        final: ImageManifest | None = None
+        committed = False
         try:
-            self._run_instructions(m, instrs, staging, context_dir, vars_,
-                                   kukefile_path)
-        except BaseException:
-            self.store.abort(staging)
-            raise
-        self.store.commit(m, staging)
-        return m
+            for idx, stage_instrs in enumerate(stages):
+                m = ImageManifest(name=name, tag=tag_)
+                staging = self.store.stage(f"{name}:{tag_}")
+                stagings.append(staging)
+                stage_name = self._run_instructions(
+                    m, stage_instrs, staging, context_dir, dict(vars_),
+                    kukefile_path, stage_roots, stage_manifests,
+                )
+                rootfs = os.path.join(staging, "rootfs")
+                for key in (str(idx), stage_name):
+                    if key:
+                        stage_roots[key] = rootfs
+                        stage_manifests[key] = m
+                final = m
+            assert final is not None
+            self.store.commit(final, stagings[-1])
+            committed = True
+        finally:
+            # On success the last staging was renamed by commit; on any
+            # failure (including a failed commit) every staging still on
+            # disk is reaped.
+            for s in stagings[:-1] if committed else stagings:
+                self.store.abort(s)
+        return final
 
     def _run_instructions(self, m: ImageManifest, instrs: list[Instruction],
                           staging: str, context_dir: str,
-                          vars_: dict[str, str], kukefile_path: str) -> None:
+                          vars_: dict[str, str], kukefile_path: str,
+                          stage_roots: dict[str, str] | None = None,
+                          stage_manifests: dict[str, ImageManifest] | None = None,
+                          ) -> str | None:
         rootfs = os.path.join(staging, "rootfs")
-        seen_from = False
+        stage_roots = stage_roots or {}
+        stage_manifests = stage_manifests or {}
+        stage_name: str | None = None
 
         for ins in instrs:
             rest = ins.args[0]
@@ -440,13 +479,26 @@ class ImageBuilder:
                 arg_name, _, default = rest.partition("=")
                 vars_.setdefault(arg_name.strip(), default.strip())
             elif ins.op == "FROM":
-                if seen_from:
-                    raise InvalidArgument(
-                        f"{kukefile_path}: multi-stage builds not supported"
-                    )
-                seen_from = True
                 base_ref = _subst(rest, vars_).strip()
-                if base_ref != "scratch":
+                # `FROM x AS name` — record the stage alias.
+                as_match = re.match(r"(.*?)\s+AS\s+(\S+)\s*$", base_ref,
+                                    re.IGNORECASE)
+                if as_match:
+                    base_ref, stage_name = as_match.group(1).strip(), as_match.group(2)
+                if base_ref in stage_roots:
+                    # Docker semantics: FROM <stage> inherits the stage's
+                    # config, not just its filesystem.
+                    prev = stage_manifests.get(base_ref)
+                    if prev is not None:
+                        m.parent = prev.parent
+                        m.entrypoint = list(prev.entrypoint)
+                        m.cmd = list(prev.cmd)
+                        m.env = dict(prev.env)
+                        m.workdir = prev.workdir
+                        m.labels = dict(prev.labels)
+                    shutil.rmtree(rootfs, ignore_errors=True)
+                    shutil.copytree(stage_roots[base_ref], rootfs, symlinks=True)
+                elif base_ref != "scratch":
                     base = self.store.get(base_ref)   # NotFound if missing
                     m.parent = base.ref
                     m.entrypoint = list(base.entrypoint)
@@ -459,9 +511,19 @@ class ImageBuilder:
                                     symlinks=True)
             elif ins.op == "COPY":
                 parts = shlex.split(_subst(rest, vars_))
+                src_root = context_dir
+                if parts and parts[0].startswith("--from="):
+                    stage_key = parts[0][len("--from="):]
+                    if stage_key not in stage_roots:
+                        raise InvalidArgument(
+                            f"COPY --from={stage_key!r}: unknown stage "
+                            f"(known: {sorted(stage_roots)})"
+                        )
+                    src_root = stage_roots[stage_key]
+                    parts = parts[1:]
                 if len(parts) != 2:
                     raise InvalidArgument(f"COPY wants <src> <dst>: {rest!r}")
-                src = naming.resolve_under(context_dir, parts[0], "COPY src")
+                src = naming.resolve_under(src_root, parts[0], "COPY src")
                 dst = naming.resolve_under(rootfs, parts[1], "COPY dst")
                 if os.path.isdir(src):
                     shutil.copytree(src, dst, dirs_exist_ok=True, symlinks=True)
@@ -491,3 +553,4 @@ class ImageBuilder:
                 m.entrypoint = _parse_exec_form(_subst(rest, vars_))
             elif ins.op == "CMD":
                 m.cmd = _parse_exec_form(_subst(rest, vars_))
+        return stage_name

@@ -35,8 +35,15 @@ def pin_or_verify(run_path: str, facts: dict[str, str]) -> None:
     try:
         fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
     except FileExistsError:
-        with open(path) as f:
-            pinned = json.load(f)
+        try:
+            with open(path) as f:
+                pinned = json.load(f)
+        except ValueError:
+            # Torn first-boot write (crash mid-dump before this code wrote
+            # atomically): an unreadable pin must not crash-loop the daemon
+            # with a raw traceback forever — re-pin the current facts.
+            _atomic_write(path, facts)
+            return
         drift = {
             k: (pinned.get(k), v)
             for k, v in facts.items()
@@ -53,8 +60,17 @@ def pin_or_verify(run_path: str, facts: dict[str, str]) -> None:
                 f"bootstrap a fresh --run-path."
             )
         return
-    with os.fdopen(fd, "w") as f:
+    # O_EXCL reserved the slot; the content lands atomically via a sibling
+    # temp file so a crash can never leave a half-written pin.
+    os.close(fd)
+    _atomic_write(path, facts)
+
+
+def _atomic_write(path: str, facts: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(facts, f, indent=1)
+    os.replace(tmp, path)
 
 
 def read(run_path: str) -> dict | None:

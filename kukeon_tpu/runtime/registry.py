@@ -1,0 +1,328 @@
+"""OCI registry client: `kuke image pull` over the distribution HTTP API.
+
+Reference: the kukebuild module's registry auth (cmd/kukebuild/auth.go:
+125-154 — docker-config credential precedence) and internal/ctr/image.go
+(pull into the runtime's image namespace). This client speaks the OCI
+distribution spec directly — /v2 ping, Bearer token dance, manifest
+(+ manifest list) negotiation, config blob, gzip layer blobs applied in
+order with OCI whiteout semantics — and commits the result into the
+ImageStore as a flattened bundle.
+
+Auth precedence (highest wins), mirroring the reference's resolution:
+  1. KUKE_REGISTRY_USER / KUKE_REGISTRY_PASSWORD env,
+  2. docker config ($DOCKER_CONFIG/config.json, else ~/.docker/config.json):
+     auths.<registry>.auth (base64 user:pass) or username/password fields,
+  3. anonymous.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import re
+import shutil
+import tarfile
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from kukeon_tpu.runtime.errors import InvalidArgument, KukeonError, NotFound
+from kukeon_tpu.runtime.images import ImageManifest, ImageStore, split_ref
+
+MT_MANIFEST_LIST = "application/vnd.docker.distribution.manifest.list.v2+json"
+MT_OCI_INDEX = "application/vnd.oci.image.index.v1+json"
+MT_MANIFEST = "application/vnd.docker.distribution.manifest.v2+json"
+MT_OCI_MANIFEST = "application/vnd.oci.image.manifest.v1+json"
+_ACCEPT = ", ".join((MT_OCI_MANIFEST, MT_MANIFEST, MT_OCI_INDEX, MT_MANIFEST_LIST))
+
+
+def parse_image_ref(ref: str) -> tuple[str, str, str]:
+    """ref -> (registry, repository, tag). Docker rules: the first path
+    component is a registry host when it contains '.' or ':' or is
+    'localhost'; bare refs have no registry (and cannot be pulled)."""
+    name, tag = split_ref(ref)
+    first, _, rest = name.partition("/")
+    if rest and ("." in first or ":" in first or first == "localhost"):
+        return first, rest, tag
+    return "", name, tag
+
+
+class RegistryAuth:
+    """Credential resolution + Bearer token cache for one registry."""
+
+    def __init__(self, registry: str):
+        self.registry = registry
+        self.basic = self._resolve_basic()
+        self.token: str | None = None
+
+    def _resolve_basic(self) -> str | None:
+        user = os.environ.get("KUKE_REGISTRY_USER")
+        password = os.environ.get("KUKE_REGISTRY_PASSWORD")
+        if user and password is not None:
+            return base64.b64encode(f"{user}:{password}".encode()).decode()
+        cfg_dir = os.environ.get("DOCKER_CONFIG") or os.path.expanduser("~/.docker")
+        path = os.path.join(cfg_dir, "config.json")
+        try:
+            with open(path) as f:
+                cfg = json.load(f)
+        except (OSError, ValueError):
+            return None
+        auths = cfg.get("auths") or {}
+        entry = (
+            auths.get(self.registry)
+            or auths.get(f"https://{self.registry}")
+            or auths.get(f"http://{self.registry}")
+        )
+        if not entry:
+            return None
+        if entry.get("auth"):
+            return entry["auth"]
+        if entry.get("username") is not None and entry.get("password") is not None:
+            return base64.b64encode(
+                f"{entry['username']}:{entry['password']}".encode()
+            ).decode()
+        return None
+
+    def headers(self) -> dict[str, str]:
+        if self.token:
+            return {"Authorization": f"Bearer {self.token}"}
+        if self.basic:
+            return {"Authorization": f"Basic {self.basic}"}
+        return {}
+
+    def handle_challenge(self, www_authenticate: str) -> bool:
+        """Bearer challenge -> fetch a token from the realm (with basic
+        creds when we have them). Returns True when a token was obtained."""
+        m = re.match(r"\s*Bearer\s+(.*)", www_authenticate, re.IGNORECASE)
+        if not m:
+            return False
+        params = dict(re.findall(r'(\w+)="([^"]*)"', m.group(1)))
+        realm = params.get("realm")
+        if not realm:
+            return False
+        q = {k: v for k, v in params.items() if k in ("service", "scope")}
+        url = realm + ("?" + urllib.parse.urlencode(q) if q else "")
+        req = urllib.request.Request(url)
+        if self.basic:
+            req.add_header("Authorization", f"Basic {self.basic}")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                doc = json.load(r)
+        except (urllib.error.URLError, ValueError):
+            return False
+        self.token = doc.get("token") or doc.get("access_token")
+        return bool(self.token)
+
+
+class RegistryClient:
+    def __init__(self, registry: str, *, insecure: bool | None = None):
+        if not registry:
+            raise InvalidArgument(
+                "image ref has no registry host (want host[:port]/repo[:tag])"
+            )
+        self.registry = registry
+        # Plain HTTP for localhost registries (the docker daemon's implicit
+        # insecure-registry rule); everything else is HTTPS.
+        if insecure is None:
+            host = registry.split(":")[0]
+            insecure = host in ("localhost", "127.0.0.1", "::1")
+        self.scheme = "http" if insecure else "https"
+        self.auth = RegistryAuth(registry)
+
+    def _url(self, path: str) -> str:
+        return f"{self.scheme}://{self.registry}{path}"
+
+    def _get(self, path: str, accept: str | None = None,
+             retry_auth: bool = True) -> tuple[bytes, dict]:
+        req = urllib.request.Request(self._url(path))
+        if accept:
+            req.add_header("Accept", accept)
+        for k, v in self.auth.headers().items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return r.read(), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            if e.code == 401 and retry_auth and self.auth.handle_challenge(
+                e.headers.get("WWW-Authenticate", "")
+            ):
+                return self._get(path, accept, retry_auth=False)
+            if e.code == 404:
+                raise NotFound(f"{self.registry}{path}: not found") from None
+            raise KukeonError(
+                f"registry {self.registry}: GET {path} -> {e.code}"
+            ) from None
+        except urllib.error.URLError as e:
+            raise KukeonError(f"registry {self.registry}: {e.reason}") from None
+
+    # --- pull ---------------------------------------------------------------
+
+    def manifest(self, repo: str, reference: str) -> dict:
+        data, headers = self._get(
+            f"/v2/{repo}/manifests/{reference}", accept=_ACCEPT
+        )
+        doc = json.loads(data)
+        mt = doc.get("mediaType") or headers.get("Content-Type", "")
+        if mt in (MT_MANIFEST_LIST, MT_OCI_INDEX) or "manifests" in doc:
+            chosen = self._pick_platform(doc.get("manifests") or [])
+            return self.manifest(repo, chosen["digest"])
+        return doc
+
+    @staticmethod
+    def _pick_platform(entries: list[dict]) -> dict:
+        import platform
+
+        arch = {"x86_64": "amd64", "aarch64": "arm64"}.get(
+            platform.machine(), platform.machine()
+        )
+        for e in entries:
+            p = e.get("platform") or {}
+            if p.get("os", "linux") == "linux" and p.get("architecture") == arch:
+                return e
+        if entries:
+            return entries[0]
+        raise KukeonError("manifest list has no entries")
+
+    def blob(self, repo: str, digest: str) -> bytes:
+        data, _ = self._get(f"/v2/{repo}/blobs/{digest}")
+        self._verify_digest(data, digest)
+        return data
+
+    @staticmethod
+    def _verify_digest(data: bytes, digest: str) -> None:
+        algo, _, want = digest.partition(":")
+        if algo == "sha256":
+            got = hashlib.sha256(data).hexdigest()
+            if got != want:
+                raise KukeonError(
+                    f"blob {digest}: digest mismatch (got sha256:{got})"
+                )
+
+    def blob_to_file(self, repo: str, digest: str, out) -> None:
+        """Stream a blob to a (seekable) file object with incremental
+        digest verification — layer blobs can be multi-GB and the daemon is
+        long-lived; buffering them whole would spike RSS per pull."""
+        path = f"/v2/{repo}/blobs/{digest}"
+        req = urllib.request.Request(self._url(path))
+        for k, v in self.auth.headers().items():
+            req.add_header(k, v)
+        h = hashlib.sha256()
+        try:
+            with urllib.request.urlopen(req, timeout=300) as r:
+                while True:
+                    chunk = r.read(1 << 20)
+                    if not chunk:
+                        break
+                    h.update(chunk)
+                    out.write(chunk)
+        except urllib.error.HTTPError as e:
+            if e.code == 401 and self.auth.handle_challenge(
+                e.headers.get("WWW-Authenticate", "")
+            ):
+                out.seek(0)
+                out.truncate()
+                return self.blob_to_file(repo, digest, out)
+            if e.code == 404:
+                raise NotFound(f"{self.registry}{path}: not found") from None
+            raise KukeonError(
+                f"registry {self.registry}: GET {path} -> {e.code}"
+            ) from None
+        except urllib.error.URLError as e:
+            raise KukeonError(f"registry {self.registry}: {e.reason}") from None
+        algo, _, want = digest.partition(":")
+        if algo == "sha256" and h.hexdigest() != want:
+            raise KukeonError(
+                f"blob {digest}: digest mismatch (got sha256:{h.hexdigest()})"
+            )
+
+
+def _apply_layer(rootfs: str, tar_file, media_type: str) -> None:
+    """Extract one layer over the rootfs with OCI whiteout semantics:
+    `.wh.<name>` deletes <name> from lower layers; `.wh..wh..opq` makes the
+    directory opaque (drops all lower content).
+
+    Whiteout targets are clamped under the rootfs (naming.resolve_under) —
+    the daemon pulls as root and a hostile layer naming
+    ``../../etc/.wh.shadow`` must die loudly, never delete host files. The
+    ``data`` extraction filter already rejects escaping paths/symlinks for
+    regular members.
+    """
+    from kukeon_tpu.runtime import naming
+
+    tar_file.seek(0)
+    head = tar_file.read(2)
+    tar_file.seek(0)
+    mode = "r:gz" if (media_type.endswith("gzip") or head == b"\x1f\x8b") else "r:"
+    with tarfile.open(fileobj=tar_file, mode=mode) as tf:
+        members = tf.getmembers()
+        for mem in members:
+            name = mem.name.lstrip("./")
+            base = os.path.basename(name)
+            if base == ".wh..wh..opq":
+                target = naming.resolve_under(
+                    rootfs, os.path.dirname(name), "layer whiteout")
+                if os.path.isdir(target) and not os.path.islink(target):
+                    for entry in os.listdir(target):
+                        p = os.path.join(target, entry)
+                        shutil.rmtree(p) if os.path.isdir(p) and not os.path.islink(p) else os.unlink(p)
+                continue
+            if base.startswith(".wh."):
+                target = naming.resolve_under(
+                    rootfs,
+                    os.path.join(os.path.dirname(name), base[len(".wh."):]),
+                    "layer whiteout",
+                )
+                if os.path.isdir(target) and not os.path.islink(target):
+                    shutil.rmtree(target, ignore_errors=True)
+                elif os.path.lexists(target):
+                    os.unlink(target)
+                continue
+        tf.extractall(rootfs, filter="data", members=[
+            mem for mem in members
+            if not os.path.basename(mem.name).startswith(".wh.")
+        ])
+
+
+def pull(store: ImageStore, ref: str, *, insecure: bool | None = None) -> ImageManifest:
+    """Pull ``registry/repo[:tag]`` into the store as a flattened bundle."""
+    registry, repo, tag = parse_image_ref(ref)
+    client = RegistryClient(registry, insecure=insecure)
+    manifest = client.manifest(repo, tag)
+
+    config: dict = {}
+    cfg_desc = manifest.get("config") or {}
+    if cfg_desc.get("digest"):
+        config = json.loads(client.blob(repo, cfg_desc["digest"]))
+    cc = config.get("config") or {}
+
+    name = f"{registry}/{repo}"
+    m = ImageManifest(
+        name=name, tag=tag,
+        entrypoint=list(cc.get("Entrypoint") or []),
+        cmd=list(cc.get("Cmd") or []),
+        env={k: v for k, _, v in
+             (e.partition("=") for e in (cc.get("Env") or []))},
+        workdir=cc.get("WorkingDir") or "",
+        labels=dict(cc.get("Labels") or {}),
+    )
+    m.labels["kukeon.io/pulled-from"] = registry
+    staging = store.stage(m.ref)
+    try:
+        import tempfile
+
+        rootfs = os.path.join(staging, "rootfs")
+        layers = manifest.get("layers") or []
+        digests = []
+        for layer in layers:
+            with tempfile.TemporaryFile(dir=staging) as tmp:
+                client.blob_to_file(repo, layer["digest"], tmp)
+                _apply_layer(rootfs, tmp, layer.get("mediaType", ""))
+            digests.append(layer["digest"])
+        m.labels["kukeon.io/layers"] = ",".join(d[-16:] for d in digests)
+    except BaseException:
+        store.abort(staging)
+        raise
+    store.commit(m, staging)
+    return m

@@ -511,7 +511,9 @@ class Runner:
             # path re-enters here from the reconcile tick — a dead remote
             # must not stall daemon-wide supervision for its full timeout on
             # EVERY restart of a crash-looping sibling.
-            fail_key = (self._owner_key(rec), spec.name, i)
+            # url/ref in the key: editing the spec to fix a bad repo must
+            # bust the cache immediately, not serve the stale failure.
+            fail_key = (self._owner_key(rec), spec.name, i, repo.url, repo.ref)
             last = self._repo_failures.get(fail_key, 0.0)
             if time.time() - last < consts.REPO_RETRY_SECONDS:
                 st.state = "failed"

@@ -1,0 +1,485 @@
+"""OCI registry pull: distribution API, Bearer auth, docker-config
+credentials, layer application with whiteouts (VERDICT r3 item 8).
+
+No network egress in CI, so the registry is a real in-process HTTP server
+speaking the distribution protocol — the client exercises the exact bytes
+a Docker Hub / GCR pull would."""
+
+from __future__ import annotations
+
+import base64
+import gzip
+import hashlib
+import io
+import json
+import os
+import tarfile
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kukeon_tpu.runtime import registry
+from kukeon_tpu.runtime.errors import KukeonError, NotFound
+from kukeon_tpu.runtime.images import ImageStore
+
+
+def _tar_layer(files: dict[str, bytes | None]) -> bytes:
+    """files: path -> content; None marks a whiteout entry; paths ending in
+    an executable bit hint ('!x' suffix) get mode 0755."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for path, content in files.items():
+            mode = 0o644
+            if path.endswith("!x"):
+                path, mode = path[:-2], 0o755
+            if content is None:
+                d, b = os.path.split(path)
+                path = os.path.join(d, ".wh." + b)
+                content = b""
+            info = tarfile.TarInfo(path)
+            info.size = len(content)
+            info.mode = mode
+            tf.addfile(info, io.BytesIO(content))
+    return gzip.compress(buf.getvalue())
+
+
+def _digest(data: bytes) -> str:
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+class FakeRegistry:
+    """Minimal OCI distribution server: /v2 ping, token endpoint, manifests
+    (list + image), blobs. Optionally requires Bearer auth."""
+
+    def __init__(self, *, require_auth: bool = False,
+                 user: str = "kuke", password: str = "sekrit"):
+        self.blobs: dict[str, bytes] = {}
+        self.manifests: dict[tuple[str, str], tuple[bytes, str]] = {}
+        self.require_auth = require_auth
+        self.user, self.password = user, password
+        self.token = "tok-" + hashlib.sha256(password.encode()).hexdigest()[:8]
+        self.token_requests: list[str] = []
+
+        reg = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body=b"", ctype="application/json",
+                      headers=()):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/token"):
+                    auth = self.headers.get("Authorization", "")
+                    want = base64.b64encode(
+                        f"{reg.user}:{reg.password}".encode()).decode()
+                    reg.token_requests.append(self.path)
+                    if reg.require_auth and auth != f"Basic {want}":
+                        self._send(401, b'{"error": "bad creds"}')
+                        return
+                    self._send(200, json.dumps({"token": reg.token}).encode())
+                    return
+                if reg.require_auth and self.headers.get(
+                    "Authorization"
+                ) != f"Bearer {reg.token}":
+                    self._send(
+                        401, b"{}",
+                        headers=[(
+                            "WWW-Authenticate",
+                            f'Bearer realm="http://{self.headers["Host"]}/token",'
+                            f'service="fake",scope="repository:pull"',
+                        )],
+                    )
+                    return
+                parts = self.path.split("/")
+                if len(parts) >= 5 and parts[1] == "v2":
+                    repo = "/".join(parts[2:-2])
+                    kind, ref = parts[-2], parts[-1]
+                    if kind == "manifests":
+                        entry = reg.manifests.get((repo, ref))
+                        if not entry:
+                            self._send(404, b"{}")
+                            return
+                        body, mt = entry
+                        self._send(200, body, ctype=mt)
+                        return
+                    if kind == "blobs":
+                        blob = reg.blobs.get(ref)
+                        if blob is None:
+                            self._send(404, b"{}")
+                            return
+                        self._send(200, blob,
+                                   ctype="application/octet-stream")
+                        return
+                self._send(404, b"{}")
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    @property
+    def host(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def add_image(self, repo: str, tag: str,
+                  layers: list[bytes], config: dict,
+                  *, via_index: bool = False) -> None:
+        cfg_bytes = json.dumps(config).encode()
+        self.blobs[_digest(cfg_bytes)] = cfg_bytes
+        layer_descs = []
+        for data in layers:
+            self.blobs[_digest(data)] = data
+            layer_descs.append({
+                "mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+                "digest": _digest(data), "size": len(data),
+            })
+        manifest = json.dumps({
+            "schemaVersion": 2,
+            "mediaType": registry.MT_OCI_MANIFEST,
+            "config": {"mediaType": "application/vnd.oci.image.config.v1+json",
+                       "digest": _digest(cfg_bytes), "size": len(cfg_bytes)},
+            "layers": layer_descs,
+        }).encode()
+        mdigest = _digest(manifest)
+        self.manifests[(repo, mdigest)] = (manifest, registry.MT_OCI_MANIFEST)
+        if via_index:
+            import platform
+
+            arch = {"x86_64": "amd64", "aarch64": "arm64"}.get(
+                platform.machine(), platform.machine())
+            index = json.dumps({
+                "schemaVersion": 2,
+                "mediaType": registry.MT_OCI_INDEX,
+                "manifests": [
+                    {"mediaType": registry.MT_OCI_MANIFEST, "digest": mdigest,
+                     "size": len(manifest),
+                     "platform": {"os": "linux", "architecture": "s390x"}},
+                    {"mediaType": registry.MT_OCI_MANIFEST, "digest": mdigest,
+                     "size": len(manifest),
+                     "platform": {"os": "linux", "architecture": arch}},
+                ],
+            }).encode()
+            self.manifests[(repo, tag)] = (index, registry.MT_OCI_INDEX)
+        else:
+            self.manifests[(repo, tag)] = (manifest, registry.MT_OCI_MANIFEST)
+
+    def close(self):
+        self.server.shutdown()
+
+
+CONFIG = {
+    "architecture": "amd64", "os": "linux",
+    "config": {
+        "Entrypoint": ["/bin/app"], "Cmd": ["--serve"],
+        "Env": ["PATH=/usr/bin", "MODE=prod"],
+        "WorkingDir": "/srv", "Labels": {"team": "kukeon"},
+    },
+}
+
+
+class TestParseRef:
+    def test_registry_detection(self):
+        assert registry.parse_image_ref("localhost:5000/a/b:v1") == (
+            "localhost:5000", "a/b", "v1")
+        assert registry.parse_image_ref("gcr.io/proj/img") == (
+            "gcr.io", "proj/img", "latest")
+        assert registry.parse_image_ref("busybox:1.36") == ("", "busybox", "1.36")
+
+    def test_bare_ref_rejected(self):
+        from kukeon_tpu.runtime.errors import InvalidArgument
+
+        with pytest.raises(InvalidArgument, match="registry"):
+            registry.RegistryClient("")
+
+
+class TestPull:
+    def test_pull_layers_config_and_whiteouts(self, tmp_path):
+        reg = FakeRegistry()
+        try:
+            layers = [
+                _tar_layer({"etc/keep.txt": b"keep", "etc/gone.txt": b"tmp",
+                            "bin/app": b"#!app"}),
+                _tar_layer({"etc/gone.txt": None, "etc/new.txt": b"new"}),
+            ]
+            reg.add_image("team/tool", "v1", layers, CONFIG)
+            store = ImageStore(str(tmp_path))
+            m = registry.pull(store, f"{reg.host}/team/tool:v1")
+            assert m.entrypoint == ["/bin/app"]
+            assert m.cmd == ["--serve"]
+            assert m.env["MODE"] == "prod"
+            assert m.workdir == "/srv"
+            assert m.labels["team"] == "kukeon"
+            root = store.rootfs(m.ref)
+            assert open(os.path.join(root, "etc/keep.txt")).read() == "keep"
+            assert open(os.path.join(root, "etc/new.txt")).read() == "new"
+            assert not os.path.exists(os.path.join(root, "etc/gone.txt"))
+            assert not os.path.exists(os.path.join(root, "etc/.wh.gone.txt"))
+        finally:
+            reg.close()
+
+    def test_pull_via_manifest_list_picks_platform(self, tmp_path):
+        reg = FakeRegistry()
+        try:
+            reg.add_image("ml/model", "latest",
+                          [_tar_layer({"x": b"y"})], CONFIG, via_index=True)
+            store = ImageStore(str(tmp_path))
+            m = registry.pull(store, f"{reg.host}/ml/model")
+            assert os.path.exists(os.path.join(store.rootfs(m.ref), "x"))
+        finally:
+            reg.close()
+
+    def test_digest_mismatch_rejected(self, tmp_path):
+        reg = FakeRegistry()
+        try:
+            reg.add_image("a/b", "v1", [_tar_layer({"f": b"data"})], CONFIG)
+            # Corrupt every blob in place (keys = digests of the originals).
+            for key in list(reg.blobs):
+                reg.blobs[key] = reg.blobs[key] + b"X"
+            store = ImageStore(str(tmp_path))
+            with pytest.raises(KukeonError, match="digest mismatch"):
+                registry.pull(store, f"{reg.host}/a/b:v1")
+            assert not store.exists(f"{reg.host}/a/b:v1")
+        finally:
+            reg.close()
+
+    def test_missing_image_is_not_found(self, tmp_path):
+        reg = FakeRegistry()
+        try:
+            store = ImageStore(str(tmp_path))
+            with pytest.raises(NotFound):
+                registry.pull(store, f"{reg.host}/no/such:tag")
+        finally:
+            reg.close()
+
+
+class TestAuth:
+    def test_bearer_dance_with_docker_config(self, tmp_path, monkeypatch):
+        """401 -> WWW-Authenticate -> token endpoint with docker-config
+        basic creds -> retried pull succeeds (reference: auth.go
+        precedence)."""
+        reg = FakeRegistry(require_auth=True)
+        try:
+            cfg_dir = tmp_path / "docker"
+            cfg_dir.mkdir()
+            auth = base64.b64encode(b"kuke:sekrit").decode()
+            (cfg_dir / "config.json").write_text(json.dumps(
+                {"auths": {reg.host: {"auth": auth}}}
+            ))
+            monkeypatch.setenv("DOCKER_CONFIG", str(cfg_dir))
+            monkeypatch.delenv("KUKE_REGISTRY_USER", raising=False)
+            reg.add_image("priv/img", "v1", [_tar_layer({"f": b"x"})], CONFIG)
+            store = ImageStore(str(tmp_path / "store"))
+            m = registry.pull(store, f"{reg.host}/priv/img:v1")
+            assert reg.token_requests, "token endpoint was never hit"
+            assert store.exists(m.ref)
+        finally:
+            reg.close()
+
+    def test_env_overrides_docker_config(self, tmp_path, monkeypatch):
+        reg = FakeRegistry(require_auth=True)
+        try:
+            cfg_dir = tmp_path / "docker"
+            cfg_dir.mkdir()
+            bad = base64.b64encode(b"kuke:wrong").decode()
+            (cfg_dir / "config.json").write_text(json.dumps(
+                {"auths": {reg.host: {"auth": bad}}}
+            ))
+            monkeypatch.setenv("DOCKER_CONFIG", str(cfg_dir))
+            monkeypatch.setenv("KUKE_REGISTRY_USER", "kuke")
+            monkeypatch.setenv("KUKE_REGISTRY_PASSWORD", "sekrit")
+            reg.add_image("priv/img", "v1", [_tar_layer({"f": b"x"})], CONFIG)
+            store = ImageStore(str(tmp_path / "store"))
+            m = registry.pull(store, f"{reg.host}/priv/img:v1")
+            assert store.exists(m.ref)
+        finally:
+            reg.close()
+
+    def test_bad_creds_fail_clearly(self, tmp_path, monkeypatch):
+        reg = FakeRegistry(require_auth=True)
+        try:
+            monkeypatch.setenv("DOCKER_CONFIG", str(tmp_path))  # no config.json
+            monkeypatch.delenv("KUKE_REGISTRY_USER", raising=False)
+            reg.add_image("priv/img", "v1", [_tar_layer({"f": b"x"})], CONFIG)
+            store = ImageStore(str(tmp_path / "store"))
+            with pytest.raises(KukeonError):
+                registry.pull(store, f"{reg.host}/priv/img:v1")
+        finally:
+            reg.close()
+
+
+class TestMultiStageBuild:
+    def test_copy_from_builder_stage(self, tmp_path):
+        from kukeon_tpu.runtime.images import ImageBuilder
+
+        store = ImageStore(str(tmp_path))
+        ctx = tmp_path / "ctx"
+        ctx.mkdir()
+        (ctx / "src.txt").write_text("artifact-source")
+        kf = ctx / "Kukefile"
+        kf.write_text(
+            "FROM scratch AS builder\n"
+            "COPY src.txt /build/input.txt\n"
+            "RUN cp build/input.txt build/output.txt\n"
+            "\n"
+            "FROM scratch\n"
+            "COPY --from=builder /build/output.txt /app/artifact.txt\n"
+            "ENTRYPOINT [\"/app/run\"]\n"
+        )
+        b = ImageBuilder(store)
+        m = b.build(str(kf), str(ctx), "multi:1")
+        root = store.rootfs(m.ref)
+        assert open(os.path.join(root, "app/artifact.txt")).read() == "artifact-source"
+        # Builder stage contents must NOT leak into the final image.
+        assert not os.path.exists(os.path.join(root, "build"))
+        assert m.entrypoint == ["/app/run"]
+        # Builder stagings are cleaned up.
+        leftovers = [e for e in os.listdir(store.root) if e.startswith(".staging")]
+        assert not leftovers
+
+    def test_copy_from_unknown_stage_rejected(self, tmp_path):
+        from kukeon_tpu.runtime.errors import InvalidArgument
+        from kukeon_tpu.runtime.images import ImageBuilder
+
+        store = ImageStore(str(tmp_path))
+        ctx = tmp_path / "ctx"
+        ctx.mkdir()
+        kf = ctx / "Kukefile"
+        kf.write_text(
+            "FROM scratch\nCOPY --from=nope /x /y\n"
+        )
+        with pytest.raises(InvalidArgument, match="unknown stage"):
+            ImageBuilder(store).build(str(kf), str(ctx), "bad:1")
+
+
+class TestPullE2E:
+    def test_kuke_image_pull_and_serve_from_pulled_image(self, tmp_path):
+        """Black-box: `kuke image pull` from a live local registry through
+        the real daemon, then a cell runs the pulled image's entrypoint
+        inside its pivot_root'd rootfs (the image carries a static binary —
+        a from-scratch rootfs has no shell)."""
+        import subprocess
+        import sys
+        import time as _t
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_runtime_e2e import Daemon
+
+        src = tmp_path / "cat.c"
+        src.write_text(
+            '#include <stdio.h>\n'
+            'int main(void) {\n'
+            '    FILE* f = fopen("/app/hello.txt", "r");\n'
+            '    if (!f) { printf("NOFILE\\n"); return 1; }\n'
+            '    char buf[64] = {0};\n'
+            '    fread(buf, 1, 63, f);\n'
+            '    printf("%s", buf);\n'
+            '    return 0;\n'
+            '}\n'
+        )
+        binary = tmp_path / "catapp"
+        subprocess.run(["g++", "-static", "-O1", "-o", str(binary), str(src)],
+                       check=True, capture_output=True)
+
+        reg = FakeRegistry()
+        d = Daemon()
+        try:
+            config = json.loads(json.dumps(CONFIG))
+            config["config"]["Entrypoint"] = ["/bin/catapp"]
+            config["config"]["Cmd"] = []
+            reg.add_image("team/tool", "v1", [_tar_layer({
+                "app/hello.txt": b"pulled-bytes\n",
+                "bin/catapp!x": binary.read_bytes(),
+            })], config)
+            ref = f"{reg.host}/team/tool:v1"
+            p = d.kuke("image", "pull", ref)
+            assert "pulled" in p.stdout
+            out = d.kuke("image", "list").stdout
+            assert "team/tool" in out
+
+            manifest = f"""
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {{name: pulled}}
+spec:
+  containers:
+    - name: main
+      image: "{ref}"
+      restartPolicy: {{policy: never}}
+"""
+            d.kuke("apply", "-f", "-", stdin_data=manifest)
+            deadline = _t.monotonic() + 15
+            log = ""
+            while _t.monotonic() < deadline:
+                log = d.kuke("log", "pulled", check=False).stdout
+                if "pulled-bytes" in log or "NOFILE" in log:
+                    break
+                _t.sleep(0.5)
+            assert "pulled-bytes" in log, f"cell log: {log!r}"
+        finally:
+            d.stop()
+            reg.close()
+
+
+class TestLayerSafety:
+    def test_escaping_whiteout_rejected(self, tmp_path):
+        """A hostile layer naming ../../<host>/.wh.x must fail the pull,
+        never delete outside the staging rootfs (the daemon pulls as root)."""
+        import io as _io
+        import tarfile as _tarfile
+
+        buf = _io.BytesIO()
+        with _tarfile.open(fileobj=buf, mode="w") as tf:
+            info = _tarfile.TarInfo("a/../../../../outside/.wh.victim")
+            info.size = 0
+            tf.addfile(info, _io.BytesIO(b""))
+        evil = gzip.compress(buf.getvalue())
+
+        victim = tmp_path / "outside" / "victim"
+        victim.parent.mkdir()
+        victim.write_text("precious")
+
+        reg = FakeRegistry()
+        try:
+            reg.add_image("evil/img", "v1", [evil], CONFIG)
+            store = ImageStore(str(tmp_path / "store"))
+            from kukeon_tpu.runtime.errors import InvalidArgument
+
+            with pytest.raises(InvalidArgument, match="escapes"):
+                registry.pull(store, f"{reg.host}/evil/img:v1")
+            assert victim.read_text() == "precious"
+            assert not store.exists(f"{reg.host}/evil/img:v1")
+        finally:
+            reg.close()
+
+
+class TestStageMetadataInheritance:
+    def test_from_stage_inherits_config(self, tmp_path):
+        from kukeon_tpu.runtime.images import ImageBuilder
+
+        store = ImageStore(str(tmp_path))
+        ctx = tmp_path / "ctx"
+        ctx.mkdir()
+        (ctx / "f").write_text("x")
+        kf = ctx / "Kukefile"
+        kf.write_text(
+            "FROM scratch AS base\n"
+            "ENV MODE=prod\n"
+            "WORKDIR /srv\n"
+            "ENTRYPOINT [\"/bin/app\"]\n"
+            "\n"
+            "FROM base\n"
+            "COPY f /f\n"
+        )
+        m = ImageBuilder(store).build(str(kf), str(ctx), "inherit:1")
+        assert m.env.get("MODE") == "prod"
+        assert m.workdir == "/srv"
+        assert m.entrypoint == ["/bin/app"]
