@@ -191,6 +191,9 @@ class EgressRule:
     host: str | None = None          # hostname, resolved at apply/reconcile
     cidr: str | None = None
     ports: list[int] = field(default_factory=list)
+    # tcp | udp; None = unset (all protocols for a port-less rule, tcp once
+    # ports are given). DNS allowlists say `ports: [53], protocol: udp`.
+    protocol: str | None = None
 
 
 @dataclass

@@ -250,6 +250,10 @@ def validate_space(spec: t.SpaceSpec, ctx: str) -> None:
         for port in rule.ports:
             if not (1 <= port <= 65535):
                 raise InvalidArgument(f"{rctx}: port {port} out of range")
+        if (rule.protocol or "tcp").lower() not in ("tcp", "udp"):
+            raise InvalidArgument(
+                f"{rctx}: protocol must be tcp|udp, got {rule.protocol!r}"
+            )
     if spec.subnet is not None:
         try:
             net4 = ipaddress.ip_network(spec.subnet)

@@ -934,6 +934,9 @@ HANDLERS = {
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from kukeon_tpu.runtime import logging_setup
+
+    logging_setup.setup(os.environ.get("KUKEOND_LOG_LEVEL", "info"))
     try:
         return HANDLERS[args.cmd](args)
     except KukeonError as e:

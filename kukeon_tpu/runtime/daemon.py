@@ -388,8 +388,9 @@ class DaemonServer:
         self._server: _ThreadingUnixServer | None = None
 
     def serve(self) -> None:
-        from kukeon_tpu.runtime import config
+        from kukeon_tpu.runtime import config, logging_setup
 
+        logging_setup.setup(self.settings.get("KUKEOND_LOG_LEVEL"))
         os.makedirs(self.run_path, exist_ok=True)
         # First daemon start persists the resolved configuration as a
         # commented document the operator can edit (reference:

@@ -419,18 +419,27 @@ class ImageBuilder:
         with open(kukefile_path) as f:
             instrs = parse_kukefile(f.read(), origin=kukefile_path)
 
-        # Split into stages at each FROM (leading ARGs belong to stage 0).
+        # Split into stages at each FROM. Docker semantics: ARGs declared
+        # BEFORE the first FROM are global — visible to every stage's FROM
+        # line (callers' --build-arg values still win).
         stages: list[list[Instruction]] = []
         current: list[Instruction] = []
+        global_args: dict[str, str] = {}
+        seen_any_from = False
         for ins in instrs:
-            if ins.op == "FROM" and any(i.op == "FROM" for i in current):
-                stages.append(current)
-                current = []
+            if ins.op == "ARG" and not seen_any_from:
+                arg_name, _, default = ins.args[0].partition("=")
+                global_args.setdefault(arg_name.strip(), default.strip())
+            if ins.op == "FROM":
+                if seen_any_from:
+                    stages.append(current)
+                    current = []
+                seen_any_from = True
             current.append(ins)
         stages.append(current)
 
         name, tag_ = split_ref(tag)
-        vars_ = dict(build_args or {})
+        vars_ = {**global_args, **(build_args or {})}
         stage_roots: dict[str, str] = {}
         stage_manifests: dict[str, ImageManifest] = {}
         stagings: list[str] = []

@@ -1,0 +1,74 @@
+"""Logging subsystem: one timestamped, quoted-message text format.
+
+Reference: internal/logging/handler.go:28-40 — the slog ReformatHandler
+every kukeon binary installs (`time level "message" key=value ...`), plus a
+noop logger for tests. Here: a logging.Formatter with the same line shape,
+a single ``setup()`` every entrypoint calls (daemon, CLI verbs, serving
+cell), and level resolution from KUKEOND_LOG_LEVEL / ServerConfiguration.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+class ReformatFormatter(logging.Formatter):
+    """`2026-01-02T15:04:05.000Z INFO "message" logger=kukeon.runner`
+    — greppable, stable-width, message always quoted (the reference's
+    text-handler shape)."""
+
+    converter = time.gmtime
+
+    def format(self, record: logging.LogRecord) -> str:
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S", self.converter(record.created))
+        ms = int(record.msecs)
+        msg = record.getMessage().replace('"', r"\"")
+        line = f'{ts}.{ms:03d}Z {record.levelname} "{msg}" logger={record.name}'
+        if record.exc_info:
+            line += "\n" + self.formatException(record.exc_info)
+        return line
+
+
+def setup(level: str | int | None = None, stream=None) -> None:
+    """Install the kukeon handler on the root `kukeon` logger (idempotent).
+
+    ``level``: name or numeric; defaults to INFO. Child loggers
+    (kukeon.runner, kukeon.net, ...) inherit.
+    """
+    if isinstance(level, str):
+        level = _LEVELS.get(level.lower(), logging.INFO)
+    root = logging.getLogger("kukeon")
+    root.setLevel(level if level is not None else logging.INFO)
+    stream = stream or sys.stderr
+    for h in root.handlers:
+        if getattr(h, "_kukeon", False):
+            h.setStream(stream) if hasattr(h, "setStream") else None
+            return
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(ReformatFormatter())
+    handler._kukeon = True  # type: ignore[attr-defined]
+    root.addHandler(handler)
+    root.propagate = False
+
+
+class NoopHandler(logging.Handler):
+    """Swallow everything (the reference's noop logger for tests)."""
+
+    def emit(self, record: logging.LogRecord) -> None:  # noqa: D102
+        pass
+
+
+def noop() -> None:
+    root = logging.getLogger("kukeon")
+    root.handlers = [NoopHandler()]
+    root.propagate = False

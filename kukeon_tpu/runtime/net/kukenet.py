@@ -91,15 +91,17 @@ def render_table(policies: list[Policy]) -> str:
                      else f"allow[{i}]:cidr={r.cidr}")
             for dst in targets:
                 if r.ports:
+                    proto = r.protocol or "tcp"
                     for port in r.ports:
                         lines.append(
-                            f"rule chain={chain} dst={dst} proto=tcp "
+                            f"rule chain={chain} dst={dst} proto={proto} "
                             f"dport={port} verdict=ACCEPT comment={tag}:{label}"
                         )
                 else:
+                    proto_part = f"proto={r.protocol} " if r.protocol else ""
                     lines.append(
-                        f"rule chain={chain} dst={dst} verdict=ACCEPT "
-                        f"comment={tag}:{label}"
+                        f"rule chain={chain} dst={dst} {proto_part}"
+                        f"verdict=ACCEPT comment={tag}:{label}"
                     )
         terminal = "DROP" if p.default == "deny" else "ACCEPT"
         lines.append(

@@ -40,6 +40,7 @@ class ResolvedRule:
     cidr: str = ""
     ips: list[str] = field(default_factory=list)
     ports: list[int] = field(default_factory=list)
+    protocol: str | None = None      # None = all protocols (port-less rules)
     original_host: str = ""
 
 
@@ -76,7 +77,11 @@ def resolve_policy(realm: str, space: str, spec: t.NetworkSpec,
     resolver = resolver or _dns_resolve
     rules = []
     for r in spec.egress_allow:
-        rr = ResolvedRule(ports=list(r.ports))
+        # ports without protocol mean tcp; a port-less rule with no
+        # protocol admits every protocol (an explicit `protocol: udp` on a
+        # port-less rule still constrains it to udp).
+        proto = r.protocol.lower() if r.protocol else ("tcp" if r.ports else None)
+        rr = ResolvedRule(ports=list(r.ports), protocol=proto)
         if r.cidr:
             rr.cidr = r.cidr
         elif r.host:
@@ -146,14 +151,16 @@ def _allow_rules(chain: str, tag: str, idx: int, r: ResolvedRule) -> list[Rule]:
     out = []
     for dst in targets:
         if not r.ports:
+            proto_args = ("-p", r.protocol) if r.protocol else ()
             out.append(Rule("-A", chain, (
-                "-d", dst, "-m", "comment", "--comment", f"{tag}:{label}",
+                "-d", dst, *proto_args,
+                "-m", "comment", "--comment", f"{tag}:{label}",
                 "-j", "ACCEPT",
             )))
             continue
         for port in r.ports:
             out.append(Rule("-A", chain, (
-                "-d", dst, "-p", "tcp", "--dport", str(port),
+                "-d", dst, "-p", r.protocol or "tcp", "--dport", str(port),
                 "-m", "comment", "--comment", f"{tag}:{label}",
                 "-j", "ACCEPT",
             )))

@@ -134,21 +134,39 @@ class RegistryClient:
     def _url(self, path: str) -> str:
         return f"{self.scheme}://{self.registry}{path}"
 
-    def _get(self, path: str, accept: str | None = None,
-             retry_auth: bool = True) -> tuple[bytes, dict]:
+    def _request(self, path: str, accept: str | None = None, sink=None,
+                 timeout: int = 120, retry_auth: bool = True):
+        """One GET with the shared auth/error story. Without ``sink``,
+        returns (bytes, headers); with a (seekable) ``sink``, streams the
+        body into it and returns (sha256 hexdigest, headers). The 401
+        challenge is retried at most once — a registry that rejects its own
+        freshly issued tokens must fail cleanly, not recurse."""
         req = urllib.request.Request(self._url(path))
         if accept:
             req.add_header("Accept", accept)
         for k, v in self.auth.headers().items():
             req.add_header(k, v)
         try:
-            with urllib.request.urlopen(req, timeout=120) as r:
-                return r.read(), dict(r.headers)
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                if sink is None:
+                    return r.read(), dict(r.headers)
+                h = hashlib.sha256()
+                while True:
+                    chunk = r.read(1 << 20)
+                    if not chunk:
+                        break
+                    h.update(chunk)
+                    sink.write(chunk)
+                return h.hexdigest(), dict(r.headers)
         except urllib.error.HTTPError as e:
             if e.code == 401 and retry_auth and self.auth.handle_challenge(
                 e.headers.get("WWW-Authenticate", "")
             ):
-                return self._get(path, accept, retry_auth=False)
+                if sink is not None:
+                    sink.seek(0)
+                    sink.truncate()
+                return self._request(path, accept, sink, timeout,
+                                     retry_auth=False)
             if e.code == 404:
                 raise NotFound(f"{self.registry}{path}: not found") from None
             raise KukeonError(
@@ -156,6 +174,9 @@ class RegistryClient:
             ) from None
         except urllib.error.URLError as e:
             raise KukeonError(f"registry {self.registry}: {e.reason}") from None
+
+    def _get(self, path: str, accept: str | None = None) -> tuple[bytes, dict]:
+        return self._request(path, accept)
 
     # --- pull ---------------------------------------------------------------
 
@@ -181,9 +202,16 @@ class RegistryClient:
             p = e.get("platform") or {}
             if p.get("os", "linux") == "linux" and p.get("architecture") == arch:
                 return e
-        if entries:
-            return entries[0]
-        raise KukeonError("manifest list has no entries")
+        have = sorted({
+            f"{(e.get('platform') or {}).get('os', '?')}/"
+            f"{(e.get('platform') or {}).get('architecture', '?')}"
+            for e in entries
+        })
+        # Pulling a foreign-arch image "successfully" just moves the failure
+        # to an exec-format crash-loop in the cell; fail here, with names.
+        raise KukeonError(
+            f"no manifest for linux/{arch}; image provides: {have or 'none'}"
+        )
 
     def blob(self, repo: str, digest: str) -> bytes:
         data, _ = self._get(f"/v2/{repo}/blobs/{digest}")
@@ -204,37 +232,12 @@ class RegistryClient:
         """Stream a blob to a (seekable) file object with incremental
         digest verification — layer blobs can be multi-GB and the daemon is
         long-lived; buffering them whole would spike RSS per pull."""
-        path = f"/v2/{repo}/blobs/{digest}"
-        req = urllib.request.Request(self._url(path))
-        for k, v in self.auth.headers().items():
-            req.add_header(k, v)
-        h = hashlib.sha256()
-        try:
-            with urllib.request.urlopen(req, timeout=300) as r:
-                while True:
-                    chunk = r.read(1 << 20)
-                    if not chunk:
-                        break
-                    h.update(chunk)
-                    out.write(chunk)
-        except urllib.error.HTTPError as e:
-            if e.code == 401 and self.auth.handle_challenge(
-                e.headers.get("WWW-Authenticate", "")
-            ):
-                out.seek(0)
-                out.truncate()
-                return self.blob_to_file(repo, digest, out)
-            if e.code == 404:
-                raise NotFound(f"{self.registry}{path}: not found") from None
-            raise KukeonError(
-                f"registry {self.registry}: GET {path} -> {e.code}"
-            ) from None
-        except urllib.error.URLError as e:
-            raise KukeonError(f"registry {self.registry}: {e.reason}") from None
+        got, _ = self._request(f"/v2/{repo}/blobs/{digest}", sink=out,
+                               timeout=300)
         algo, _, want = digest.partition(":")
-        if algo == "sha256" and h.hexdigest() != want:
+        if algo == "sha256" and got != want:
             raise KukeonError(
-                f"blob {digest}: digest mismatch (got sha256:{h.hexdigest()})"
+                f"blob {digest}: digest mismatch (got sha256:{got})"
             )
 
 

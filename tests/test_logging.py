@@ -1,0 +1,72 @@
+"""Logging subsystem (reference: internal/logging ReformatHandler,
+handler.go:28-40): one timestamped quoted-message text format, level
+resolution, idempotent setup, noop mode."""
+
+from __future__ import annotations
+
+import io
+import logging
+import re
+
+from kukeon_tpu.runtime import logging_setup
+
+
+def _fresh_root():
+    root = logging.getLogger("kukeon")
+    root.handlers = []
+    root.setLevel(logging.NOTSET)
+    return root
+
+
+class TestReformat:
+    def test_line_shape(self):
+        _fresh_root()
+        buf = io.StringIO()
+        logging_setup.setup("info", stream=buf)
+        logging.getLogger("kukeon.runner").info('cell %s started', "web")
+        line = buf.getvalue().strip()
+        assert re.match(
+            r'^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3}Z INFO '
+            r'"cell web started" logger=kukeon\.runner$', line
+        ), line
+
+    def test_quotes_escaped(self):
+        _fresh_root()
+        buf = io.StringIO()
+        logging_setup.setup("info", stream=buf)
+        logging.getLogger("kukeon.net").warning('bad "name" given')
+        assert '\\"name\\"' in buf.getvalue()
+
+    def test_level_filtering_and_names(self):
+        _fresh_root()
+        buf = io.StringIO()
+        logging_setup.setup("warn", stream=buf)
+        log = logging.getLogger("kukeon.x")
+        log.info("hidden")
+        log.warning("shown")
+        out = buf.getvalue()
+        assert "hidden" not in out and "shown" in out
+
+    def test_setup_idempotent(self):
+        _fresh_root()
+        buf = io.StringIO()
+        logging_setup.setup("info", stream=buf)
+        logging_setup.setup("info", stream=buf)
+        logging.getLogger("kukeon.y").info("once")
+        assert buf.getvalue().count("once") == 1
+
+    def test_noop_swallows(self):
+        _fresh_root()
+        logging_setup.noop()
+        logging.getLogger("kukeon.z").error("nothing")  # must not raise/print
+
+    def test_exception_appended(self):
+        _fresh_root()
+        buf = io.StringIO()
+        logging_setup.setup("info", stream=buf)
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            logging.getLogger("kukeon.e").exception("it failed")
+        out = buf.getvalue()
+        assert '"it failed"' in out and "ValueError: boom" in out

@@ -335,3 +335,38 @@ class TestRunnerIntegration:
         from kukeon_tpu.runtime.api.wire import from_wire
         spec = from_wire(t.SpaceSpec, store.read_space("default", "web").spec_json)
         assert spec.subnet == "10.88.7.0/24"
+
+
+class TestEgressProtocol:
+    def test_portless_rule_defaults_to_all_protocols(self):
+        from kukeon_tpu.runtime.api import types as t
+        from kukeon_tpu.runtime.net.netpolicy import build_rules, resolve_policy
+
+        p = resolve_policy("r", "s", t.NetworkSpec(
+            egress_default="deny",
+            egress_allow=[t.EgressRule(cidr="10.0.0.5/32")],
+        ), resolver=lambda h: [])
+        args = [r.args for r in build_rules(p)]
+        accept = next(a for a in args if "-d" in a)
+        assert "-p" not in accept   # all protocols
+
+    def test_portless_udp_rule_constrains_protocol(self):
+        from kukeon_tpu.runtime.api import types as t
+        from kukeon_tpu.runtime.net.netpolicy import build_rules, resolve_policy
+
+        p = resolve_policy("r", "s", t.NetworkSpec(
+            egress_default="deny",
+            egress_allow=[t.EgressRule(cidr="10.0.0.5/32", protocol="udp")],
+        ), resolver=lambda h: [])
+        args = [r.args for r in build_rules(p)]
+        accept = next(a for a in args if "-d" in a)
+        assert "-p" in accept and "udp" in accept
+
+    def test_ports_default_tcp(self):
+        from kukeon_tpu.runtime.api import types as t
+        from kukeon_tpu.runtime.net.netpolicy import resolve_policy
+
+        p = resolve_policy("r", "s", t.NetworkSpec(
+            egress_allow=[t.EgressRule(cidr="10.0.0.5/32", ports=[443])],
+        ), resolver=lambda h: [])
+        assert p.allow[0].protocol == "tcp"

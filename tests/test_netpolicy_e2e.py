@@ -347,3 +347,265 @@ spec:
                      "--space", "agents").stdout
         assert f"CONNECT {EXT_IP}:8080 FAIL" in log, (
             f"model cell reached an external host under default-deny:\n{log}")
+
+
+class TestUDPAndICMP:
+    """VERDICT r3 item 10: packet-level deny semantics beyond TCP — the DNS
+    (UDP 53) allowlist is the first rule a real agent cell needs, and ICMP
+    must fall to the default verdict like everything else."""
+
+    @pytest.fixture(scope="class")
+    def udp_listener(self, external_host):
+        """UDP echo on EXT_IP:53 (the DNS port) and :5353 inside the
+        external netns."""
+        ns = ["ip", "netns", "exec", EXT_NS]
+        clean_env = {k: v for k, v in os.environ.items()
+                     if k not in ("PYTHONPATH", "PYTHONSTARTUP")}
+        listeners = []
+        for port in (53, 5353):
+            listeners.append(subprocess.Popen(
+                ns + ["python3", "-S", "-c",
+                      "import socket\n"
+                      "s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)\n"
+                      f"s.bind(('{EXT_IP}', {port}))\n"
+                      "while True:\n"
+                      "    data, addr = s.recvfrom(512)\n"
+                      f"    s.sendto(b'udp-echo-{port}:' + data, addr)\n"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=clean_env,
+            ))
+        import socket as _socket
+
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            try:
+                c = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+                c.settimeout(1)
+                c.sendto(b"ping", (EXT_IP, 53))
+                c.recvfrom(64)
+                c.close()
+                break
+            except OSError:
+                time.sleep(0.2)
+        else:
+            raise RuntimeError("udp listener never answered")
+        yield EXT_IP
+        for p in listeners:
+            p.kill()
+
+    UDP_PROBE = (
+        "import socket\n"
+        "def probe(ip, port):\n"
+        "    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)\n"
+        "    s.settimeout(3)\n"
+        "    try:\n"
+        "        s.sendto(b'hi', (ip, port))\n"
+        "        data, _ = s.recvfrom(128)\n"
+        "        print(f'UDP {ip}:{port} OK', data.decode())\n"
+        "    except Exception as e:\n"
+        "        print(f'UDP {ip}:{port} FAIL {type(e).__name__}')\n"
+        "    finally:\n"
+        "        s.close()\n"
+    )
+
+    def test_udp_dns_allowlist(self, daemon, udp_listener):
+        """default-deny + udp:53 allow: DNS flows, other UDP ports drop."""
+        d = daemon
+        d.kuke("apply", "-f", "-", stdin_data=f"""
+apiVersion: kukeon.io/v1beta1
+kind: Space
+metadata: {{name: dnsonly}}
+spec:
+  network:
+    egressDefault: deny
+    egressAllow:
+      - {{cidr: {EXT_IP}/32, ports: [53], protocol: udp}}
+""")
+        body = self.UDP_PROBE + (
+            f"probe({EXT_IP!r}, 53)\n"
+            f"probe({EXT_IP!r}, 5353)\n"
+        )
+        manifest = f"""
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {{name: dnsprobe, space: dnsonly}}
+spec:
+  containers:
+    - name: main
+      command: ["python3", "-S", "-c", {body!r}]
+      restartPolicy: {{policy: never}}
+"""
+        d.kuke("apply", "-f", "-", stdin_data=manifest)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            import json as _json
+
+            rec = _json.loads(d.kuke("--json", "get", "cells", "dnsprobe",
+                                     "--space", "dnsonly").stdout)
+            if rec["status"]["containers"][0]["state"] == "exited":
+                break
+            time.sleep(0.3)
+        log = d.kuke("log", "dnsprobe", "--space", "dnsonly").stdout
+        assert f"UDP {EXT_IP}:53 OK" in log, log
+        assert f"UDP {EXT_IP}:5353 FAIL" in log, log
+
+    def test_udp_denied_without_allowlist(self, daemon, udp_listener):
+        d = daemon
+        d.kuke("apply", "-f", "-", stdin_data="""
+apiVersion: kukeon.io/v1beta1
+kind: Space
+metadata: {name: nodns}
+spec:
+  network: {egressDefault: deny}
+""")
+        body = self.UDP_PROBE + f"probe({EXT_IP!r}, 53)\n"
+        manifest = f"""
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {{name: noprobe, space: nodns}}
+spec:
+  containers:
+    - name: main
+      command: ["python3", "-S", "-c", {body!r}]
+      restartPolicy: {{policy: never}}
+"""
+        d.kuke("apply", "-f", "-", stdin_data=manifest)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            import json as _json
+
+            rec = _json.loads(d.kuke("--json", "get", "cells", "noprobe",
+                                     "--space", "nodns").stdout)
+            if rec["status"]["containers"][0]["state"] == "exited":
+                break
+            time.sleep(0.3)
+        log = d.kuke("log", "noprobe", "--space", "nodns").stdout
+        assert f"UDP {EXT_IP}:53 FAIL" in log, log
+
+    ICMP_PROBE = (
+        "import socket, struct, os, time\n"
+        "def ping(ip):\n"
+        "    s = socket.socket(socket.AF_INET, socket.SOCK_RAW,\n"
+        "                      socket.IPPROTO_ICMP)\n"
+        "    s.settimeout(3)\n"
+        "    payload = struct.pack('!BBHHH', 8, 0, 0, os.getpid() & 0xFFFF, 1)\n"
+        "    csum = 0\n"
+        "    for i in range(0, len(payload), 2):\n"
+        "        csum += (payload[i] << 8) + payload[i+1]\n"
+        "    csum = ~((csum >> 16) + (csum & 0xFFFF)) & 0xFFFF\n"
+        "    pkt = struct.pack('!BBHHH', 8, 0, csum, os.getpid() & 0xFFFF, 1)\n"
+        "    try:\n"
+        "        s.sendto(pkt, (ip, 0))\n"
+        "        s.recvfrom(256)\n"
+        "        print(f'ICMP {ip} OK')\n"
+        "    except Exception as e:\n"
+        "        print(f'ICMP {ip} FAIL {type(e).__name__}')\n"
+        "    finally:\n"
+        "        s.close()\n"
+    )
+
+    def test_icmp_follows_default_verdict(self, daemon, external_host):
+        """ICMP echo: dropped under default-deny, flows under default-allow
+        (the cell runs as root, so SOCK_RAW is available in its netns)."""
+        d = daemon
+        for space, default, expect in (("pingdeny", "deny", "FAIL"),
+                                       ("pingok", "allow", "OK")):
+            d.kuke("apply", "-f", "-", stdin_data=f"""
+apiVersion: kukeon.io/v1beta1
+kind: Space
+metadata: {{name: {space}}}
+spec:
+  network: {{egressDefault: {default}}}
+""")
+            body = self.ICMP_PROBE + f"ping({EXT_IP!r})\n"
+            manifest = f"""
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {{name: pinger, space: {space}}}
+spec:
+  containers:
+    - name: main
+      command: ["python3", "-S", "-c", {body!r}]
+      restartPolicy: {{policy: never}}
+"""
+            d.kuke("apply", "-f", "-", stdin_data=manifest)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                import json as _json
+
+                rec = _json.loads(d.kuke("--json", "get", "cells", "pinger",
+                                         "--space", space).stdout)
+                if rec["status"]["containers"][0]["state"] == "exited":
+                    break
+                time.sleep(0.3)
+            log = d.kuke("log", "pinger", "--space", space).stdout
+            assert f"ICMP {EXT_IP} {expect}" in log, f"{space}: {log}"
+
+
+class TestSliceMeshRules:
+    """Slice-aware networking at the packet level (BASELINE config 4 /
+    north star: 'a Realm's default-deny mesh spans a v5e slice over the TPU
+    host network'): a daemon discovering peer slice workers must admit the
+    TPU runtime's DCN ports to those peers THROUGH a default-deny space,
+    while everything else stays dropped."""
+
+    def test_default_deny_admits_peer_worker_dcn(self, external_host):
+        _purge_kukeon_links()
+        # The external-host netns IP plays the PEER SLICE WORKER; 8471 is
+        # the libtpu runtime gRPC port (net/slice.py DEFAULT_SLICE_PORTS).
+        clean_env = {k: v for k, v in os.environ.items()
+                     if k not in ("PYTHONPATH", "PYTHONSTARTUP")}
+        ns = ["ip", "netns", "exec", EXT_NS]
+        listener = subprocess.Popen(
+            ns + ["python3", "-S", "-c",
+                  "import socket\n"
+                  "s = socket.socket()\n"
+                  "s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)\n"
+                  f"s.bind(('{EXT_IP}', 8471))\n"
+                  "s.listen(4)\n"
+                  "while True:\n"
+                  "    c, _ = s.accept()\n"
+                  "    c.sendall(b'dcn-grpc')\n"
+                  "    c.close()\n"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=clean_env,
+        )
+        d = Daemon(env_overrides={
+            "KUKEON_NET_ENFORCE": "1",
+            "KUKEON_SLICE_WORKERS": f"10.0.0.250,{EXT_IP}",
+            "TPU_WORKER_ID": "0",
+        })
+        try:
+            import socket as _socket
+
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                try:
+                    c = _socket.create_connection((EXT_IP, 8471), timeout=1)
+                    c.close()
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            else:
+                raise RuntimeError("dcn listener never came up")
+
+            d.kuke("apply", "-f", "-", stdin_data="""
+apiVersion: kukeon.io/v1beta1
+kind: Space
+metadata: {name: slice}
+spec:
+  network: {egressDefault: deny}
+""")
+            log = _run_probe_cell(d, "slice", "worker", [
+                (EXT_IP, 8471),   # peer worker DCN port -> admitted
+                (EXT_IP, 8080),   # same peer, non-DCN port -> dropped
+            ])
+            assert f"CONNECT {EXT_IP}:8471 OK dcn-grpc" in log, log
+            assert f"CONNECT {EXT_IP}:8080 FAIL" in log, log
+        finally:
+            listener.kill()
+            d.stop()
+            _purge_kukeon_links()
+            subprocess.run([KUKENET, "apply"], input=(
+                "policy INPUT ACCEPT\npolicy FORWARD ACCEPT\npolicy OUTPUT ACCEPT\n"
+            ), capture_output=True, text=True)

@@ -483,3 +483,30 @@ class TestStageMetadataInheritance:
         assert m.env.get("MODE") == "prod"
         assert m.workdir == "/srv"
         assert m.entrypoint == ["/bin/app"]
+
+
+class TestGlobalArgsAcrossStages:
+    def test_pre_from_arg_visible_in_every_from(self, tmp_path):
+        from kukeon_tpu.runtime.images import ImageBuilder
+
+        store = ImageStore(str(tmp_path))
+        ctx = tmp_path / "ctx"
+        ctx.mkdir()
+        (ctx / "f").write_text("x")
+        # Base image both stages resolve via ${TAG}.
+        base_kf = ctx / "Base.kukefile"
+        base_kf.write_text("FROM scratch\nENV BASE=yes\n")
+        b = ImageBuilder(store)
+        b.build(str(base_kf), str(ctx), "base:v1")
+
+        kf = ctx / "Kukefile"
+        kf.write_text(
+            "ARG TAG=v1\n"
+            "FROM base:${TAG} AS builder\n"
+            "COPY f /built\n"
+            "FROM base:${TAG}\n"
+            "COPY --from=builder /built /out\n"
+        )
+        m = b.build(str(kf), str(ctx), "multiarg:1")
+        assert m.env.get("BASE") == "yes"   # second FROM resolved base:v1
+        assert os.path.exists(os.path.join(store.rootfs(m.ref), "out"))
