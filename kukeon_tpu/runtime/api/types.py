@@ -140,6 +140,9 @@ class ContainerSpec:
     host_pid: bool = False
     read_only_root_filesystem: bool = False
     capabilities: list[str] = field(default_factory=list)
+    # reference: ContainerSpec.securityOpts (container.go) / OCI seccomp.
+    # Supported: "seccomp=default" (denylist filter) | "seccomp=unconfined".
+    security_opts: list[str] = field(default_factory=list)
     devices: list[str] = field(default_factory=list)
     resources: Resources = field(default_factory=Resources)
     secrets: list[SecretRef] = field(default_factory=list)

@@ -90,10 +90,21 @@ def validate_container(c: t.ContainerSpec, ctx: str, *,
     for vm in c.volumes:
         refs = [x for x in (vm.name, vm.host_path) if x]
         if vm.tmpfs:
-            raise InvalidArgument(
-                f"{where}: tmpfs volume mounts are not supported by this "
-                "backend yet; remove `tmpfs: true`"
-            )
+            if refs:
+                raise InvalidArgument(
+                    f"{where}: tmpfs mounts take no name/hostPath source"
+                )
+            if not vm.path or (not deferred(vm.path) and not vm.path.startswith("/")):
+                raise InvalidArgument(
+                    f"{where}: tmpfs mount needs an absolute path"
+                )
+            if vm.read_only:
+                # tmpfs mounts are always rw scratch; accepting the flag and
+                # ignoring it would fake a read-only guarantee.
+                raise InvalidArgument(
+                    f"{where}: readOnly tmpfs is not supported"
+                )
+            continue
         if len(refs) != 1:
             raise InvalidArgument(
                 f"{where}: volume mount needs exactly one of name|hostPath"
@@ -116,6 +127,15 @@ def validate_container(c: t.ContainerSpec, ctx: str, *,
             continue
         if not _CAPABILITY.match(cap):
             raise InvalidArgument(f"{where}: invalid capability {cap!r}")
+
+    for opt in c.security_opts:
+        if deferred(opt):
+            continue
+        if opt not in ("seccomp=default", "seccomp=unconfined"):
+            raise InvalidArgument(
+                f"{where}: securityOpts supports seccomp=default|unconfined, "
+                f"got {opt!r}"
+            )
 
     for d in c.devices:
         if deferred(d):

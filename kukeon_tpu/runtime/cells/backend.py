@@ -33,6 +33,7 @@ class ContainerContext:
     sandbox_pid: int | None = None        # cell sandbox to join
     devices: list[str] = field(default_factory=list)   # granted /dev nodes
     binds: list[tuple[str, str, bool]] = field(default_factory=list)  # (src, dst, ro)
+    tmpfs: list[str] = field(default_factory=list)     # private scratch mounts
 
 
 @dataclass

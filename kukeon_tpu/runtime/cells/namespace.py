@@ -154,6 +154,10 @@ class NamespaceBackend(ProcessBackend):
             argv += ["--device", dev]
         for src, dst, ro in ctx.binds:
             argv += ["--bind", f"{src}:{dst}" + (":ro" if ro else "")]
+        for dst in ctx.tmpfs:
+            argv += ["--tmpfs", dst]
+        if "seccomp=unconfined" in spec.security_opts:
+            argv += ["--seccomp", "unconfined"]
         if spec.user:
             argv += ["--user", spec.user]
         # In-image (post-pivot) path: kukecell chdirs after the namespace

@@ -718,8 +718,8 @@ class Controller:
 # namespace setup at start).
 _BREAKING_CONTAINER_FIELDS = (
     "image", "command", "args", "user", "privileged", "host_network",
-    "host_pid", "read_only_root_filesystem", "capabilities", "devices",
-    "workdir", "attachable", "tty", "secrets", "volumes", "repos",
+    "host_pid", "read_only_root_filesystem", "capabilities", "security_opts",
+    "devices", "workdir", "attachable", "tty", "secrets", "volumes", "repos",
 )
 _COMPATIBLE_CONTAINER_FIELDS = ("env", "resources", "restart_policy", "ports", "networks")
 

@@ -382,8 +382,9 @@ class Runner:
         for e in spec.env:
             env[e.name] = e.value
         binds: list[tuple[str, str, bool]] = []
+        tmpfs: list[str] = []
         self._stage_secrets(rec, spec, cdir, env, binds)
-        self._mount_volumes(rec, spec, cdir, env, binds)
+        self._mount_volumes(rec, spec, cdir, env, binds, tmpfs)
 
         sandbox_pid = None
         if self.backend.isolated:
@@ -420,6 +421,7 @@ class Runner:
             workdir=workdir,
             sandbox_pid=sandbox_pid,
             binds=binds,
+            tmpfs=tmpfs,
         )
 
     def _stage_secrets(self, rec: model.CellRecord, spec: t.ContainerSpec,
@@ -563,11 +565,29 @@ class Runner:
 
     def _mount_volumes(self, rec: model.CellRecord, spec: t.ContainerSpec,
                        cdir: str, env: dict[str, str],
-                       binds: list[tuple[str, str, bool]]) -> None:
+                       binds: list[tuple[str, str, bool]],
+                       tmpfs: list[str] | None = None) -> None:
         """Volume binding. Namespace backend: real bind mounts at the
-        declared in-cell path honoring read_only (reference: ctr/spec.go
-        volume mounts). Process backend: env pointer only."""
-        for vm in spec.volumes:
+        declared in-cell path honoring read_only, and tmpfs paths as real
+        private tmpfs mounts (reference: ctr/spec.go volume + tmpfs
+        mounts). Process backend: env pointer / scratch-dir fallback."""
+        import shutil as _shutil
+
+        tmpfs = tmpfs if tmpfs is not None else []
+        for idx, vm in enumerate(spec.volumes):
+            if vm.tmpfs:
+                if self.backend.isolated:
+                    tmpfs.append(vm.path)
+                else:
+                    # Process backend has no mount namespace: a private
+                    # scratch dir (wiped each start) + env pointer. Indexed
+                    # dir names: path mangling is lossy (/a/b vs /a-b) and
+                    # colliding scratch dirs would alias "private" mounts.
+                    scratch = os.path.join(cdir, f"tmpfs-{idx}")
+                    _shutil.rmtree(scratch, ignore_errors=True)
+                    os.makedirs(scratch, exist_ok=True)
+                    env[f"KUKEON_TMPFS_{idx}"] = scratch
+                continue
             if vm.host_path and self.backend.isolated:
                 # Direct host bind (trusted manifests only).
                 if vm.path:

@@ -16,7 +16,8 @@
 //     process leaves.
 //
 //   kukecell enter --sandbox PID [--rootfs DIR] [--bind SRC:DST[:ro]]...
-//            [--device PATH]... [--no-dev] [--readonly-root] [--cap NAME]...
+//            [--tmpfs DST]... [--device PATH]... [--no-dev]
+//            [--readonly-root] [--cap NAME]...
 //            [--privileged] [--host-net] [--host-pid] [--workdir DIR]
 //            [--user UID[:GID]] -- CMD [ARGS...]
 //     Join the sandbox's namespaces, build a private mount namespace
@@ -38,6 +39,9 @@
 #include <cstdlib>
 #include <cstring>
 #include <fcntl.h>
+#include <linux/audit.h>
+#include <linux/filter.h>
+#include <linux/seccomp.h>
 #include <sched.h>
 #include <string>
 #include <sys/mount.h>
@@ -98,6 +102,69 @@ static int cap_lookup(const std::string& raw) {
     for (const auto& c : kCaps)
         if (s == c.name) return c.value;
     return -1;
+}
+
+// --- seccomp ---------------------------------------------------------------
+//
+// Default denylist filter (the Docker-default-profile subset that matters
+// for a cell that already dropped its capability bounding set): kernel
+// surface no agent workload needs and several namespace-escape staples.
+// Reference analog: internal/ctr/spec.go security opts carry the OCI
+// seccomp profile; here the filter is built directly as classic BPF so
+// there is no libseccomp dependency. Denied calls fail with EPERM (not
+// SIGKILL) so probing software degrades instead of dying.
+static void install_seccomp_denylist() {
+#ifdef __x86_64__
+    static const int denied[] = {
+        SYS_init_module, SYS_finit_module, SYS_delete_module,
+        SYS_kexec_load, SYS_kexec_file_load, SYS_reboot,
+        SYS_swapon, SYS_swapoff,
+        SYS_open_by_handle_at,          // classic container escape
+        SYS_perf_event_open, SYS_bpf, SYS_userfaultfd,
+        SYS_mount, SYS_umount2, SYS_pivot_root, SYS_move_mount,
+        SYS_fsopen, SYS_fsconfig, SYS_fsmount, SYS_open_tree,
+        SYS_setns, SYS_unshare,
+        SYS_keyctl, SYS_add_key, SYS_request_key,
+        SYS_acct, SYS_settimeofday, SYS_clock_settime, SYS_adjtimex,
+        SYS_iopl, SYS_ioperm,
+        SYS_lookup_dcookie,
+        SYS_process_vm_readv, SYS_process_vm_writev,
+    };
+    const int n = sizeof(denied) / sizeof(denied[0]);
+    std::vector<sock_filter> prog;
+    // arch check: kill on a foreign-arch syscall (x32 bypass).
+    prog.push_back(BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                            offsetof(seccomp_data, arch)));
+    prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, AUDIT_ARCH_X86_64, 1, 0));
+    prog.push_back(BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_KILL_PROCESS));
+    prog.push_back(BPF_STMT(BPF_LD | BPF_W | BPF_ABS,
+                            offsetof(seccomp_data, nr)));
+    // x32 ABI reports arch==AUDIT_ARCH_X86_64 with nr|=0x40000000 — those
+    // numbers would miss every JEQ below and fall through to ALLOW, so the
+    // whole x32 range is denied outright (Docker's default profile does
+    // the same).
+    prog.push_back(BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, 0x40000000u, 0, 1));
+    prog.push_back(BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_KILL_PROCESS));
+    for (int k = 0; k < n; k++) {
+        // match -> jump to the shared EPERM return at the end.
+        prog.push_back(BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K,
+                                (unsigned)denied[k],
+                                (unsigned char)(n - 1 - k + 1), 0));
+    }
+    prog.push_back(BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW));
+    prog.push_back(BPF_STMT(BPF_RET | BPF_K,
+                            SECCOMP_RET_ERRNO | (EPERM & SECCOMP_RET_DATA)));
+    sock_fprog fprog = { (unsigned short)prog.size(), prog.data() };
+    // no_new_privs is already set by the caller; SECCOMP_MODE_FILTER
+    // requires it for unprivileged installers.
+    if (prctl(PR_SET_SECCOMP, SECCOMP_MODE_FILTER, &fprog, 0, 0) != 0)
+        die("seccomp filter");
+#else
+    // Fail closed: a silently absent security control is worse than a
+    // loud unsupported-arch error (request --seccomp unconfined to opt out).
+    errno = ENOSYS;
+    die("seccomp denylist not implemented for this architecture");
+#endif
 }
 
 static void drop_bounding_set(const std::vector<int>& keep) {
@@ -314,8 +381,9 @@ static void forward_sig(int sig) {
 
 static int cmd_enter(int argc, char** argv) {
     pid_t sandbox = -1;
-    std::string rootfs, overlay_dir, workdir, user;
+    std::string rootfs, overlay_dir, workdir, user, seccomp_mode = "default";
     std::vector<BindSpec> binds;
+    std::vector<std::string> tmpfs_mounts;
     std::vector<std::string> devices;
     std::vector<std::string> cap_adds;
     bool readonly_root = false, privileged = false;
@@ -345,6 +413,8 @@ static int cmd_enter(int argc, char** argv) {
             }
             binds.push_back({spec.substr(0, sep), spec.substr(sep + 1), ro});
         }
+        else if (a == "--tmpfs" && i + 1 < argc) tmpfs_mounts.push_back(argv[++i]);
+        else if (a == "--seccomp" && i + 1 < argc) seccomp_mode = argv[++i];
         else if (a == "--device" && i + 1 < argc) devices.push_back(argv[++i]);
         else if (a == "--cap" && i + 1 < argc) cap_adds.push_back(argv[++i]);
         else if (a == "--readonly-root") readonly_root = true;
@@ -445,6 +515,26 @@ static int cmd_enter(int argc, char** argv) {
         setup_dev(root, devices);
     for (const auto& b : binds)
         bind_mount(b.src, pivot ? root + b.dst : b.dst, b.ro, true);
+    // Private scratch mounts (reference: OCI spec tmpfs mounts,
+    // internal/ctr/spec.go): per-cell, die with the mount namespace. In
+    // the pivot case the mount point is created inside the image rootfs /
+    // overlay; host-rootfs cells must target an EXISTING directory —
+    // mkdir'ing it would permanently dropping-ify the real host fs (the
+    // mount is private, the directory is not).
+    for (const auto& t : tmpfs_mounts) {
+        std::string dst = pivot ? root + t : t;
+        struct stat st;
+        if (pivot) {
+            mkdir_p(dst);
+        } else if (stat(dst.c_str(), &st) != 0 || !S_ISDIR(st.st_mode)) {
+            fprintf(stderr, "kukecell: tmpfs mount point %s must be an "
+                    "existing directory for host-rootfs cells\n", t.c_str());
+            _exit(125);
+        }
+        if (mount("tmpfs", dst.c_str(), "tmpfs", MS_NOSUID | MS_NODEV,
+                  "mode=1777") != 0)
+            die("mount tmpfs");
+    }
 
     if (pivot) {
         if (chdir(root.c_str()) != 0) die("chdir rootfs");
@@ -474,6 +564,8 @@ static int cmd_enter(int argc, char** argv) {
             drop_bounding_set(keep);
             if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0)
                 die("no_new_privs");
+            if (seccomp_mode != "unconfined")
+                install_seccomp_denylist();
         }
         if (!user.empty()) {
             // Numeric UID[:GID] only — a name silently atoi'ing to 0 would

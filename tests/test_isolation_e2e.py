@@ -297,3 +297,74 @@ spec:
         _apply(daemon, manifest)
         _wait_exit(daemon, "roprobe")
         assert "VOLUME_RO" in _log(daemon, "roprobe")
+
+
+def test_tmpfs_mount_is_private_and_ephemeral(daemon):
+    """tmpfs volume mounts (reference: OCI spec tmpfs, ctr/spec.go): a real
+    tmpfs inside the cell's mount namespace — writable under a read-only
+    root, invisible from the host, gone on restart."""
+    # Mount over /tmp: exists on every host (host-rootfs cells must target
+    # an existing dir — kukecell refuses to mkdir on the real host fs), and
+    # doubles as proof the cell's scratch masks the host's /tmp.
+    manifest = """
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {name: scratchy}
+spec:
+  containers:
+    - name: main
+      command: ["sh", "-c",
+                "grep ' /tmp tmpfs' /proc/mounts | head -1; \
+                 echo private > /tmp/kuke-tmpfs-probe && cat /tmp/kuke-tmpfs-probe; \
+                 sleep 30"]
+      volumes: [{path: /tmp, tmpfs: true}]
+"""
+    _apply(daemon, manifest)
+    time.sleep(1.5)
+    log = _log(daemon, "scratchy")
+    assert "tmpfs" in log, log
+    assert "private" in log, log
+    # Host's /tmp is untouched (the mount lives in the cell's namespace).
+    assert not os.path.exists("/tmp/kuke-tmpfs-probe")
+    daemon.kuke("delete", "cell", "scratchy", "--force")
+
+
+def test_seccomp_denylist_blocks_namespace_escapes(daemon):
+    """The default seccomp filter (reference: OCI seccomp profile via
+    securityOpts, ctr/spec.go) denies namespace/kernel-surface syscalls with
+    EPERM. Probe: unshare(CLONE_NEWUSER) needs NO capability (it would
+    succeed in a plain process), so its failure isolates the filter;
+    seccomp=unconfined restores it."""
+    probe = (
+        "import ctypes, os\n"
+        "libc = ctypes.CDLL(None, use_errno=True)\n"
+        "CLONE_NEWUSER = 0x10000000\n"
+        "rc = libc.unshare(CLONE_NEWUSER)\n"
+        "err = ctypes.get_errno()\n"
+        "print('UNSHARE', 'OK' if rc == 0 else f'DENIED errno={err}')\n"
+        "try:\n"
+        "    os.open('/proc/1/root', os.O_RDONLY)\n"
+        "    print('MOUNTPROBE unexpected')\n"
+        "except OSError:\n"
+        "    pass\n"
+    )
+    for name, opts, expect in (
+        ("filt", "", "UNSHARE DENIED errno=1"),
+        ("nofilt", "securityOpts: [seccomp=unconfined]", "UNSHARE OK"),
+    ):
+        manifest = f"""
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {{name: {name}}}
+spec:
+  containers:
+    - name: main
+      command: ["python3", "-S", "-c", {probe!r}]
+      {opts}
+      restartPolicy: {{policy: never}}
+"""
+        _apply(daemon, manifest)
+        _wait_exit(daemon, name)
+        log = _log(daemon, name)
+        assert expect in log, f"{name}: {log}"
+        daemon.kuke("delete", "cell", name, "--force")

@@ -63,8 +63,11 @@ INVALID = [
         "    - {name: a, command: [sh], ports: [{port: 80}]}\n"
         "    - {name: b, command: [sh], ports: [{port: 80}]}"),
      "more than one container"),
-    ("tmpfs-unsupported", cell(
+    ("tmpfs-with-source", cell(
         "  containers: [{name: m, command: [sh], volumes: [{path: /scratch, tmpfs: true, name: v}]}]"),
+     "tmpfs"),
+    ("tmpfs-no-path", cell(
+        "  containers: [{name: m, command: [sh], volumes: [{tmpfs: true}]}]"),
      "tmpfs"),
     ("volume-no-source", cell(
         "  containers: [{name: m, command: [sh], volumes: [{path: /data}]}]"),
@@ -211,6 +214,8 @@ VALID = [
         "      restartPolicy: {policy: on-failure, backoffSeconds: 2, maxRetries: 3}\n"
         "      attachable: true\n"
         "      tty: {prompt: '$ ', logLevel: debug}\n")),
+    ("tmpfs-mount", cell(
+        "  containers: [{name: m, command: [sh], volumes: [{path: /scratch, tmpfs: true}]}]")),
     ("model-cell", cell(
         "  model: {model: llama3-8b, chips: 8, port: 9000, numSlots: 16,\n"
         "          maxSeqLen: 4096, dtype: int8, hostNetwork: true}")),
