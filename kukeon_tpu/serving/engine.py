@@ -120,18 +120,31 @@ class ServingEngine:
         int8_pallas: bool | None = None,
     ):
         # int8_pallas=None -> auto: route quantized decode matmuls through
-        # the Pallas kernel on a single-chip TPU mesh (multi-chip meshes keep
-        # XLA's dequant dot, which GSPMD partitions; a pallas_call would
-        # force all-gathers of the sharded weights). Explicit True/False is
-        # authoritative either way — False must clear a flag already set on
-        # cfg, or a multi-chip engine handed a pallas-enabled cfg would
-        # all-gather full weights every layer.
+        # the Pallas kernel on a single-chip TPU mesh when the operator opts
+        # in (KUKEON_INT8_PALLAS=1). Microbenchmarks on v5e measured the
+        # kernel at parity with XLA 0.9's dequant-fused dot (both at the
+        # HBM roof), so the default stays on the XLA path; the env knob
+        # exists for XLA versions whose fusion regresses. Multi-chip meshes
+        # always keep XLA's dot: GSPMD partitions it, while a pallas_call
+        # would force all-gathers of the sharded weights. Explicit
+        # True/False is authoritative either way — False must clear a flag
+        # already set on cfg.
         if int8_pallas is None:
-            int8_pallas = cfg.int8_pallas or (
-                jax.default_backend() == "tpu"
+            import os as _os
+
+            env_wants = (
+                _os.environ.get("KUKEON_INT8_PALLAS", "").lower()
+                in ("1", "true", "yes", "on")
+                and jax.default_backend() == "tpu"
+                and llama._is_q(params.get("layers", {}).get("wq"))
+            )
+            # The mesh guard applies to BOTH triggers: auto mode must clear
+            # a pallas-enabled cfg on a multi-chip mesh (per-layer weight
+            # all-gathers), not just decline to set it.
+            int8_pallas = (
+                (cfg.int8_pallas or env_wants)
                 and mesh is not None
                 and mesh.size == 1
-                and llama._is_q(params.get("layers", {}).get("wq"))
             )
         if cfg.int8_pallas != int8_pallas:
             cfg = dataclasses.replace(cfg, int8_pallas=int8_pallas)

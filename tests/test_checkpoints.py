@@ -201,6 +201,31 @@ class TestInt8PallasFlag:
         eng = ServingEngine(cfg, qp, mesh, num_slots=2, max_seq_len=64)
         assert eng.cfg.int8_pallas is False   # cpu backend -> auto stays off
 
+    def test_env_knob_requires_tpu_and_auto_clears_on_multichip(self, monkeypatch):
+        """KUKEON_INT8_PALLAS=true must not enable pallas on CPU, and auto
+        mode must CLEAR a pallas-enabled cfg on a multi-chip mesh (the
+        per-layer all-gather hazard)."""
+        import dataclasses
+
+        from kukeon_tpu.parallel import make_mesh
+        from kukeon_tpu.serving import ServingEngine
+
+        cfg = _tiny_cfg()
+        qp = llama.quantize_params(llama.init_params(jax.random.key(0), cfg))
+        monkeypatch.setenv("KUKEON_INT8_PALLAS", "true")
+        mesh1 = make_mesh(tensor=1, devices=jax.devices()[:1])
+        eng = ServingEngine(cfg, qp, mesh1, num_slots=2, max_seq_len=64)
+        assert eng.cfg.int8_pallas is False   # cpu backend blocks the env knob
+
+        cfg8 = dataclasses.replace(cfg, int8_pallas=True)
+        mesh2 = make_mesh(tensor=2, devices=jax.devices()[:2])
+        eng = ServingEngine(cfg8, qp, mesh2, num_slots=2, max_seq_len=64)
+        assert eng.cfg.int8_pallas is False   # multi-chip auto-clears
+
+        mesh1b = make_mesh(tensor=1, devices=jax.devices()[:1])
+        eng = ServingEngine(cfg8, qp, mesh1b, num_slots=2, max_seq_len=64)
+        assert eng.cfg.int8_pallas is True    # single-device cfg flag honored
+
     def test_engine_explicit_false_clears_cfg_flag(self):
         """int8_pallas=False must override a flag already set on cfg (a
         multi-chip engine handed a pallas cfg would all-gather weights)."""
