@@ -70,34 +70,34 @@ def detect_backend() -> tuple[str, int]:
     A hung/unreachable TPU runtime (tunnel down, chip wedged) degrades to
     the CPU smoke instead of failing the whole benchmark: a measured CPU
     line beats no line."""
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d = jax.devices(); "
-             "print(jax.default_backend(), len(d))"],
-            capture_output=True, text=True, timeout=300, cwd=REPO,
-            env=subprocess_env(),
-        )
-        if out.returncode == 0:
-            backend, n = out.stdout.split()[-2:]
-            return backend, int(n)
-        _log(f"backend probe failed:\n{out.stderr[-1500:]}")
-    except subprocess.TimeoutExpired:
-        _log("backend probe timed out (TPU runtime unreachable)")
-    # Forced-CPU fallback probe. The env mutation is load-bearing: every
-    # later child (serve phase, cold-start daemons) builds its env from
+
+    def probe() -> tuple[str, int] | str:
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend(), len(jax.devices()))"],
+                capture_output=True, text=True, timeout=300, cwd=REPO,
+                env=subprocess_env(),
+            )
+        except subprocess.TimeoutExpired:
+            return "probe timed out (runtime unreachable)"
+        if out.returncode != 0:
+            return f"probe failed:\n{out.stderr[-1500:]}"
+        backend, n = out.stdout.split()[-2:]
+        return backend, int(n)
+
+    got = probe()
+    if isinstance(got, tuple):
+        return got
+    _log(f"backend {got}")
+    # Forced-CPU fallback. The env mutation is load-bearing: every later
+    # child (serve phase, cold-start daemons) builds its env from
     # os.environ via subprocess_env().
     os.environ["JAX_PLATFORMS"] = "cpu"
-    out = subprocess.run(
-        [sys.executable, "-c",
-         "import jax; print(jax.default_backend(), len(jax.devices()))"],
-        capture_output=True, text=True, timeout=300, cwd=REPO,
-        env=subprocess_env(),
-    )
-    if out.returncode != 0:
-        raise RuntimeError(f"cpu fallback probe failed:\n{out.stderr[-2000:]}")
-    backend, n = out.stdout.split()[-2:]
-    return backend, int(n)
+    got = probe()
+    if isinstance(got, tuple):
+        return got
+    raise RuntimeError(f"cpu fallback {got}")
 
 
 # --- checkpoint prep (host-only, no TPU) -------------------------------------
