@@ -609,3 +609,81 @@ spec:
             subprocess.run([KUKENET, "apply"], input=(
                 "policy INPUT ACCEPT\npolicy FORWARD ACCEPT\npolicy OUTPUT ACCEPT\n"
             ), capture_output=True, text=True)
+
+
+class TestAgentStackSharesModel:
+    """BASELINE config 3: a 4-cell coding-agent Stack sharing one model
+    cell — all four agents generate concurrently against the model over the
+    space bridge, inside a default-deny space."""
+
+    def test_four_agents_generate_against_shared_model(self, daemon):
+        import json as _json
+        import urllib.request
+
+        d = daemon
+        d.kuke("apply", "-f", "-", stdin_data="""
+apiVersion: kukeon.io/v1beta1
+kind: Space
+metadata: {name: team}
+spec:
+  network: {egressDefault: deny}
+---
+apiVersion: kukeon.io/v1beta1
+kind: Stack
+metadata: {name: agents, space: team}
+---
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {name: llm, space: team}
+spec:
+  model: {model: tiny, chips: 1, port: 9497, numSlots: 4, maxSeqLen: 128}
+""")
+        rec = _json.loads(d.kuke("--json", "get", "cells", "llm",
+                                 "--space", "team").stdout)
+        ip = rec["status"]["ip"]
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(f"http://{ip}:9497/v1/health", timeout=1)
+                break
+            except OSError:
+                time.sleep(1)
+        else:
+            raise AssertionError("model cell never healthy")
+
+        agent_body = (
+            "import json, urllib.request, os\n"
+            f"req = urllib.request.Request('http://{ip}:9497/v1/generate',\n"
+            "    data=json.dumps({'promptTokens': [3, 1, 4, 1, 5],\n"
+            "                     'maxNewTokens': 6}).encode(),\n"
+            "    headers={'Content-Type': 'application/json'})\n"
+            "out = json.load(urllib.request.urlopen(req, timeout=120))\n"
+            "print('AGENT', os.environ.get('KUKEON_CELL'), 'GOT',\n"
+            "      out['numTokens'], 'tokens')\n"
+        )
+        for i in range(4):
+            d.kuke("apply", "-f", "-", stdin_data=f"""
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {{name: agent{i}, space: team, stack: agents}}
+spec:
+  containers:
+    - name: main
+      command: ["python3", "-S", "-c", {agent_body!r}]
+      restartPolicy: {{policy: never}}
+""")
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            states = []
+            for i in range(4):
+                rec = _json.loads(d.kuke(
+                    "--json", "get", "cells", f"agent{i}", "--space", "team",
+                    "--stack", "agents").stdout)
+                states.append(rec["status"]["containers"][0]["state"])
+            if all(s == "exited" for s in states):
+                break
+            time.sleep(0.5)
+        for i in range(4):
+            log = d.kuke("log", f"agent{i}", "--space", "team",
+                         "--stack", "agents").stdout
+            assert f"AGENT agent{i} GOT 6 tokens" in log, f"agent{i}: {log}"
