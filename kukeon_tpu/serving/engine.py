@@ -70,13 +70,23 @@ class Request:
     id: int
     prompt: np.ndarray
     sampling: SamplingParams
-    emit: Callable[[int, bool], None] | None = None   # (token, done)
+    # (token, done); a cancelled request's terminal event is (-1, True).
+    emit: Callable[[int, bool], None] | None = None
     generated: list[int] = dataclasses.field(default_factory=list)
     error: Exception | None = None
     slot: int = -1
     submitted_at: float = 0.0
     first_token_at: float = 0.0
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    cancelled: bool = False
+
+    def cancel(self) -> None:
+        """Ask the engine to stop generating for this request. Thread-safe:
+        only sets a flag; the driver (step loop) acts on it on its next
+        iteration — releasing the slot for an active request, or completing
+        a still-queued one without waiting for a slot — so engine state is
+        never touched off-thread. Waiters wake via ``done``."""
+        self.cancelled = True
 
 
 @dataclasses.dataclass
@@ -387,6 +397,40 @@ class ServingEngine:
 
     # --- engine core -------------------------------------------------------
 
+    def _sweep_cancelled(self) -> bool:
+        """Driver-thread cancellation: release active cancelled slots and
+        complete queued cancelled requests NOW — a queued cancel must not
+        wait for a slot to free before its waiter wakes."""
+        did = False
+        for _slot, req in self._active_requests():
+            if req.cancelled and not req.done.is_set():
+                self._release_slot(req, cancelled=True)
+                did = True
+        # Drain-and-refill: Queue supports no removal. Concurrent submits
+        # during the refill just land behind the kept entries.
+        kept: list[Request] = []
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            if req.cancelled:
+                self._finish_cancelled(req)
+                did = True
+            else:
+                kept.append(req)
+        for req in kept:
+            self._pending.put(req)
+        return did
+
+    def _finish_cancelled(self, req: Request) -> None:
+        """Complete a never-started cancelled request (no slot involved)."""
+        with self._lock:
+            self._requests.pop(req.id, None)
+        if req.emit:
+            req.emit(-1, True)
+        req.done.set()
+
     def _free_slots(self) -> list[int]:
         return [i for i, r in enumerate(self._slot_req) if r is None]
 
@@ -405,12 +449,23 @@ class ServingEngine:
 
         Returns True if any work was done.
         """
-        did_work = False
+        did_work = self._sweep_cancelled()
         prefills = []
         for slot in self._free_slots():
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
+            # Pop until a live request: a burst of queued-then-cancelled
+            # requests (client disconnects) must not cost this free slot a
+            # step each.
+            req = None
+            while req is None:
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                if req.cancelled:
+                    self._finish_cancelled(req)
+                    did_work = True
+                    req = None
+            if req is None:
                 break
             prefills.append(self._dispatch_prefill(req, slot))
             did_work = True
@@ -546,7 +601,7 @@ class ServingEngine:
         if finished:
             self._release_slot(req)
 
-    def _release_slot(self, req: Request):
+    def _release_slot(self, req: Request, cancelled: bool = False):
         slot = req.slot
         self._slot_req[slot] = None
         self.state = DecodeState(
@@ -556,4 +611,8 @@ class ServingEngine:
         )
         with self._lock:
             self._requests.pop(req.id, None)
+        if cancelled and req.emit:
+            # Streaming consumers need a terminal event on their channel;
+            # cancellation produces no token, so the sentinel is (-1, True).
+            req.emit(-1, True)
         req.done.set()

@@ -90,3 +90,71 @@ def test_greedy_determinism_survives_churn():
         while not (again.done.is_set() and all(r.done.is_set() for r in noise)):
             eng.step()
         assert again.generated == baseline, f"round {round_} diverged"
+
+
+def test_cancel_frees_slot_and_wakes_waiter():
+    """A cancelled request releases its slot on the next driver iteration;
+    a queued-but-unstarted cancelled request never occupies one."""
+    import time as _time
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(3), cfg)
+    mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+    eng = ServingEngine(cfg, params, mesh, num_slots=1, max_seq_len=96,
+                        decode_chunk=4)
+    prompt = np.arange(1, 12, dtype=np.int32)
+
+    # Long-running request occupies THE slot...
+    hog = eng.submit(prompt, SamplingParams(temperature=0.0,
+                                            max_new_tokens=64))
+    # ...a second request queues behind it, and a third is cancelled
+    # while still queued.
+    waiter = eng.submit(prompt, SamplingParams(temperature=0.0,
+                                               max_new_tokens=3))
+    ghost = eng.submit(prompt, SamplingParams(temperature=0.0,
+                                              max_new_tokens=3))
+    ghost.cancel()
+
+    for _ in range(3):
+        eng.step()
+    assert not hog.done.is_set()
+    hog.cancel()
+
+    deadline = _time.monotonic() + 60
+    while not (hog.done.is_set() and waiter.done.is_set()
+               and ghost.done.is_set()):
+        if _time.monotonic() > deadline:
+            raise AssertionError("cancel did not unblock the queue")
+        eng.step()
+
+    assert len(hog.generated) < 64          # stopped early
+    assert len(waiter.generated) == 3       # got the freed slot
+    assert ghost.generated == []            # never ran
+    assert len(eng._free_slots()) == eng.num_slots
+    assert not eng._requests                # no leaked request records
+
+
+def test_queued_cancel_completes_while_slots_stay_busy():
+    """Cancelling a QUEUED request must complete it promptly even when no
+    slot ever frees, and its emit callback gets the (-1, True) terminal."""
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(4), cfg)
+    mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+    eng = ServingEngine(cfg, params, mesh, num_slots=1, max_seq_len=96,
+                        decode_chunk=4)
+    prompt = np.arange(1, 10, dtype=np.int32)
+    hog = eng.submit(prompt, SamplingParams(temperature=0.0,
+                                            max_new_tokens=64))
+    events: list[tuple[int, bool]] = []
+    ghost = eng.submit(prompt,
+                       SamplingParams(temperature=0.0, max_new_tokens=3),
+                       emit=lambda tok, done: events.append((tok, done)))
+    ghost.cancel()
+    for _ in range(3):
+        eng.step()
+    assert ghost.done.is_set()              # completed without a free slot
+    assert events == [(-1, True)]           # terminal sentinel delivered
+    assert not hog.done.is_set()            # the busy slot was untouched
+    hog.cancel()
+    while not hog.done.is_set():
+        eng.step()
