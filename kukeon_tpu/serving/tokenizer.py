@@ -53,7 +53,13 @@ class HFTokenizer:
 
     def decode(self, ids: list[int]) -> str:
         drop = {i for i in (self.bos_id, self.eos_id) if i is not None}
-        return self.tk.decode([i for i in ids if i not in drop])
+        # Out-of-vocab ids are dropped, not fatal: a random-init model (or a
+        # model whose vocab exceeds the tokenizer's, as padded checkpoints
+        # do) samples ids the tokenizer never minted, and /v1/generate must
+        # degrade to partial text rather than 500.
+        return self.tk.decode([
+            i for i in ids if i not in drop and 0 <= i < self.vocab_size
+        ])
 
 
 def load_tokenizer(checkpoint_dir: str | None):

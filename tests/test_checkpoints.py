@@ -240,3 +240,17 @@ class TestInt8PallasFlag:
         eng = ServingEngine(cfg, qp, mesh, num_slots=2, max_seq_len=64,
                             int8_pallas=False)
         assert eng.cfg.int8_pallas is False
+
+
+class TestTokenizerRobustness:
+    def test_decode_tolerates_out_of_vocab_ids(self, tmp_path):
+        """A random-init model samples the MODEL vocab (e.g. 128256); the
+        tokenizer's vocab can be smaller — decode must degrade, not raise."""
+        from kukeon_tpu.serving.tokenizer import load_tokenizer
+
+        checkpoints.write_tokenizer_json(str(tmp_path))
+        tok = load_tokenizer(str(tmp_path))
+        ids = tok.encode("hello")
+        garbled = ids + [tok.vocab_size + 999, 127999, -5]
+        out = tok.decode(garbled)
+        assert "hello" in out
