@@ -141,16 +141,35 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class KVCache:
-    """Decode cache. k/v: [L, B, S_max, KV, D]; lengths: [B] used slots."""
+    """Decode cache. k/v: [L, B, S_max, KV, D]; lengths: [B] used slots.
+
+    Quantized form (``create(..., quantized=True)``): k/v are int8 with
+    per-token per-kv-head symmetric scales k_scale/v_scale [L, B, S_max, KV]
+    f32 — halves the cache's HBM bytes, the dominant decode stream once
+    contexts grow (weights are already int8 in the flagship config). Dequant
+    is fused into the decode attention dots (ops/attention.py
+    decode_gqa_attention), so int8 is what actually crosses HBM.
+    """
 
     k: jnp.ndarray
     v: jnp.ndarray
     lengths: jnp.ndarray
+    k_scale: jnp.ndarray | None = None
+    v_scale: jnp.ndarray | None = None
 
     @staticmethod
-    def create(cfg: LlamaConfig, batch: int, max_len: int, dtype=None) -> "KVCache":
+    def create(cfg: LlamaConfig, batch: int, max_len: int, dtype=None,
+               quantized: bool = False) -> "KVCache":
         dtype = dtype or cfg.dtype
         shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+        if quantized:
+            return KVCache(
+                k=jnp.zeros(shape, jnp.int8),
+                v=jnp.zeros(shape, jnp.int8),
+                lengths=jnp.zeros((batch,), jnp.int32),
+                k_scale=jnp.zeros(shape[:-1], jnp.float32),
+                v_scale=jnp.zeros(shape[:-1], jnp.float32),
+            )
         return KVCache(
             k=jnp.zeros(shape, dtype),
             v=jnp.zeros(shape, dtype),
@@ -161,9 +180,20 @@ class KVCache:
     def max_len(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-token per-head symmetric int8 over the last (head_dim) axis:
+    x ≈ q * s[..., None]. x: [..., D] -> (int8 [..., D], f32 [...])."""
+    q, s = _int8_sym(x, -1)
+    return q, jnp.squeeze(s, axis=-1)
+
 
 def _cache_insert(cache_kv: jnp.ndarray, new_kv: jnp.ndarray, offsets: jnp.ndarray) -> jnp.ndarray:
-    """Insert [B, S, KV, D] at per-batch ``offsets`` into [B, S_max, KV, D].
+    """Insert [B, S, ...] at per-batch ``offsets`` into [B, S_max, ...].
 
     Unrolled over the (small, static) batch: per-row dynamic_update_slice
     stays a real in-place slice write. A vmap'd DUS with per-row offsets
@@ -171,9 +201,10 @@ def _cache_insert(cache_kv: jnp.ndarray, new_kv: jnp.ndarray, offsets: jnp.ndarr
     large cache — so the loop is the fast path, not a naive one.
     """
     B = cache_kv.shape[0]
+    zeros = (0,) * (cache_kv.ndim - 2)
     for b in range(B):
         cache_kv = jax.lax.dynamic_update_slice(
-            cache_kv, new_kv[b : b + 1], (b, offsets[b], 0, 0)
+            cache_kv, new_kv[b : b + 1], (b, offsets[b]) + zeros
         )
     return cache_kv
 
@@ -187,15 +218,25 @@ def _cache_insert(cache_kv: jnp.ndarray, new_kv: jnp.ndarray, offsets: jnp.ndarr
 # 8B-class weights (~8 GB int8) fit a single 16 GB v5e chip.
 
 
+def _int8_sym(w: jnp.ndarray, axis: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """THE device symmetric-int8 recipe: w ≈ q * s, s keepdims along ``axis``.
+
+    Single source of truth for every on-device quantization (weights via
+    :func:`quantize_params`, KV cache via :func:`quantize_kv`); the host copy
+    is :func:`quantize_np` and must match exactly."""
+    a = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
+    s = jnp.maximum(a / 127.0, 1e-12)
+    q = jnp.round(w.astype(jnp.float32) / s).astype(jnp.int8)
+    return q, s
+
+
 def quantize_params(params: Params) -> Params:
     """bf16 param pytree -> int8 pytree ({"q": int8, "s": f32} leaves for
     every dense matrix; norms stay as-is). Works with forward/_decode_forward
     transparently via :func:`_mm` / :func:`_embed` / :func:`_logits`."""
 
     def q(w, axis):
-        a = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
-        s = jnp.maximum(a / 127.0, 1e-12)
-        qw = jnp.round(w.astype(jnp.float32) / s).astype(jnp.int8)
+        qw, s = _int8_sym(w, axis)
         return {"q": qw, "s": jnp.squeeze(s, axis=axis)}
 
     L = params["layers"]
@@ -365,19 +406,35 @@ def forward(
         k = apply_rope(k, positions, c.rope_theta)
 
         if layer_cache is not None:
-            ck, cv = layer_cache
-            ck = _cache_insert(ck, k, offsets)
-            cv = _cache_insert(cv, v, offsets)
+            ck, cv, cks, cvs = layer_cache
+            if cks is not None:
+                # Quantized cache, generic (multi-token) path: quantize the
+                # new K/V in, then dequantize the whole layer cache for the
+                # attention. Prefill is compute-bound, so the materialized
+                # dequant is fine here; the HBM-bound decode path fuses it
+                # (_decode_forward / decode_gqa_attention).
+                qk, sk = quantize_kv(k)
+                qv, sv = quantize_kv(v)
+                ck = _cache_insert(ck, qk, offsets)
+                cv = _cache_insert(cv, qv, offsets)
+                cks = _cache_insert(cks, sk, offsets)
+                cvs = _cache_insert(cvs, sv, offsets)
+                ak = ck.astype(c.dtype) * cks[..., None].astype(c.dtype)
+                av = cv.astype(c.dtype) * cvs[..., None].astype(c.dtype)
+            else:
+                ck = _cache_insert(ck, k, offsets)
+                cv = _cache_insert(cv, v, offsets)
+                ak, av = ck, cv
             kv_positions = jnp.broadcast_to(
                 jnp.arange(ck.shape[1], dtype=jnp.int32)[None, :], (B, ck.shape[1])
             )
             kv_length = offsets + S
             attn = gqa_attention(
-                q, ck, cv,
+                q, ak, av,
                 q_positions=positions, kv_positions=kv_positions,
                 kv_length=kv_length, impl=attn_impl,
             )
-            new_layer_cache = (ck, cv)
+            new_layer_cache = (ck, cv, cks, cvs)
         else:
             attn = gqa_attention(
                 q, k, v,
@@ -397,12 +454,13 @@ def forward(
 
     layer_ws = params["layers"]
     if cache is not None:
-        x, (new_k, new_v) = jax.lax.scan(
-            lambda carry, layer: layer_step(carry, (layer[0], (layer[1], layer[2]))),
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            lambda carry, layer: layer_step(carry, (layer[0], layer[1:])),
             x,
-            (layer_ws, cache.k, cache.v),
+            (layer_ws, cache.k, cache.v, cache.k_scale, cache.v_scale),
         )
-        new_cache = KVCache(k=new_k, v=new_v, lengths=cache.lengths + S)
+        new_cache = KVCache(k=new_k, v=new_v, lengths=cache.lengths + S,
+                            k_scale=new_ks, v_scale=new_vs)
     else:
         x, _ = jax.lax.scan(
             lambda carry, w: layer_step(carry, (w, None)), x, layer_ws
@@ -429,7 +487,8 @@ def _decode_forward(
     (append-free attention scores the new token separately), emits only the
     tiny per-layer new K/V, and the cache is updated once per step with
     per-slot in-place slice writes. Cache bytes stream through HBM exactly
-    once per step.
+    once per step — and for a quantized cache those bytes are int8, with
+    dequant fused into the attention dots.
     """
     from kukeon_tpu.ops.attention import decode_gqa_attention
 
@@ -437,7 +496,7 @@ def _decode_forward(
     pl8 = c.int8_pallas
 
     def layer_step(x, layer):
-        w, ck, cv = layer
+        w, ck, cv, cks, cvs = layer
         h = rms_norm(x, w["attn_norm"], c.rms_norm_eps)
         q = _mm(h, w["wq"], pl8).reshape(B, 1, c.num_heads, c.head_dim)
         k = _mm(h, w["wk"], pl8).reshape(B, 1, c.num_kv_heads, c.head_dim)
@@ -445,7 +504,8 @@ def _decode_forward(
         q = apply_rope(q, positions, c.rope_theta)
         k = apply_rope(k, positions, c.rope_theta)
 
-        attn = decode_gqa_attention(q, k, v, ck, cv, offsets)
+        attn = decode_gqa_attention(q, k, v, ck, cv, offsets,
+                                    k_scale=cks, v_scale=cvs)
         x = x + _mm(attn.reshape(B, 1, c.q_dim), w["wo"], pl8)
 
         h = rms_norm(x, w["mlp_norm"], c.rms_norm_eps)
@@ -457,16 +517,26 @@ def _decode_forward(
     x, (new_k, new_v) = jax.lax.scan(
         lambda carry, layer: layer_step(carry, layer),
         x,
-        (params["layers"], cache.k, cache.v),
+        (params["layers"], cache.k, cache.v, cache.k_scale, cache.v_scale),
     )
     # new_k/new_v: [L, B, 1, KV, D] — one in-place slice write per slot
     # covering every layer at once (layers share the slot's offset).
     k_upd, v_upd = cache.k, cache.v
+    ks_upd, vs_upd = cache.k_scale, cache.v_scale
+    if cache.quantized:
+        new_k, new_ks = quantize_kv(new_k)       # [L, B, 1, KV, D] / [L, B, 1, KV]
+        new_v, new_vs = quantize_kv(new_v)
     for b in range(B):
         start = (0, b, offsets[b], 0, 0)
         k_upd = jax.lax.dynamic_update_slice(k_upd, new_k[:, b : b + 1], start)
         v_upd = jax.lax.dynamic_update_slice(v_upd, new_v[:, b : b + 1], start)
-    new_cache = KVCache(k=k_upd, v=v_upd, lengths=cache.lengths + 1)
+        if cache.quantized:
+            ks_upd = jax.lax.dynamic_update_slice(
+                ks_upd, new_ks[:, b : b + 1], start[:-1])
+            vs_upd = jax.lax.dynamic_update_slice(
+                vs_upd, new_vs[:, b : b + 1], start[:-1])
+    new_cache = KVCache(k=k_upd, v=v_upd, lengths=cache.lengths + 1,
+                        k_scale=ks_upd, v_scale=vs_upd)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
     return _logits(params, c, x, pl8), new_cache

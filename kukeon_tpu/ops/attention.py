@@ -113,6 +113,8 @@ def decode_gqa_attention(
     cache_k: jnp.ndarray,
     cache_v: jnp.ndarray,
     lengths: jnp.ndarray,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Single-token decode attention against a cache, append-free.
 
@@ -122,8 +124,15 @@ def decode_gqa_attention(
     contributes one extra score, softmaxed together. The caller inserts the
     new K/V into the cache once per step, outside the layer scan.
 
+    Quantized cache: cache_k/cache_v int8 with per-token per-head scales
+    k_scale/v_scale [B, S, KV]. Dequant is fused: the score dot runs on the
+    int8 keys (convert folds into the einsum, so int8 is the HBM stream) and
+    the per-token key scale multiplies the f32 scores; the value scale folds
+    into the probabilities before the value dot. Exact same math as
+    dequantize-then-attend, at half the cache bytes.
+
     Args:
-      q: [B, 1, H, D]; k_new, v_new: [B, 1, KV, D];
+      q: [B, 1, H, D]; k_new, v_new: [B, 1, KV, D] (always full precision);
       cache_k, cache_v: [B, S, KV, D]; lengths: [B] valid cache slots.
 
     Returns: [B, 1, H, D].
@@ -133,11 +142,18 @@ def decode_gqa_attention(
     KV = cache_k.shape[2]
     G = H // KV
     scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    dt = q.dtype
 
     qg = q.reshape(B, KV, G, D)
+    # Only the quantized path converts (int8 -> activation dtype folds into
+    # the dot); a full-precision cache keeps its own dtype so callers with a
+    # wider-than-activations cache lose nothing.
+    ck = cache_k.astype(dt) if k_scale is not None else cache_k
     s_cache = jnp.einsum(
-        "bkgd,bTkd->bkgT", qg, cache_k, preferred_element_type=jnp.float32
+        "bkgd,bTkd->bkgT", qg, ck, preferred_element_type=jnp.float32
     ) * scale
+    if k_scale is not None:
+        s_cache = s_cache * k_scale.transpose(0, 2, 1)[:, :, None, :]
     valid = jnp.arange(S)[None, None, None, :] < lengths[:, None, None, None]
     s_cache = jnp.where(valid, s_cache, NEG_INF)
     s_self = jnp.einsum(
@@ -146,10 +162,17 @@ def decode_gqa_attention(
     )[..., None] * scale
 
     probs = jax.nn.softmax(jnp.concatenate([s_cache, s_self], axis=-1), axis=-1)
-    p_cache = probs[..., :S].astype(cache_v.dtype)
-    p_self = probs[..., S:].astype(cache_v.dtype)
+    p_cache = probs[..., :S]
+    if v_scale is not None:
+        p_cache = p_cache * v_scale.transpose(0, 2, 1)[:, :, None, :]
+        cv = cache_v.astype(dt)
+        p_cache = p_cache.astype(dt)
+    else:
+        cv = cache_v
+        p_cache = p_cache.astype(cache_v.dtype)
+    p_self = probs[..., S:].astype(v_new.dtype)
     out = (
-        jnp.einsum("bkgT,bTkd->bkgd", p_cache, cache_v)
+        jnp.einsum("bkgT,bTkd->bkgd", p_cache, cv)
         + p_self * v_new.reshape(B, KV, 1, D)
     )
     return out.reshape(B, 1, H, D).astype(q.dtype)

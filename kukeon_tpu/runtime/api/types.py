@@ -168,6 +168,10 @@ class ModelSpec:
     max_seq_len: int | None = None
     checkpoint: str | None = None    # orbax checkpoint dir; random-init if None
     dtype: str | None = None
+    # int8 KV cache: halves the decode-time cache HBM stream (dequant fused
+    # into the attention dots). Weights are governed by ``dtype``; this
+    # governs only the per-request KV cache.
+    kv_cache_int8: bool = False
     # Model cells live INSIDE the space network by default: the server binds
     # the cell's bridge IP, in-space agent cells reach it there, and the
     # space's default-deny egress governs its traffic (BASELINE config 4).

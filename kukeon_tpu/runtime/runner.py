@@ -240,6 +240,8 @@ class Runner:
             cmd += ["--checkpoint", m.checkpoint]
         if m.dtype:
             cmd += ["--dtype", m.dtype]
+        if m.kv_cache_int8:
+            cmd += ["--kv-cache-int8"]
         return t.ContainerSpec(
             name="model-server",
             command=cmd,

@@ -80,7 +80,8 @@ class ByteTokenizer:
 
 class ServingCell:
     def __init__(self, model: str, *, num_slots: int, max_seq_len: int | None,
-                 checkpoint: str | None, dtype: str | None, seed: int = 0):
+                 checkpoint: str | None, dtype: str | None, seed: int = 0,
+                 kv_cache_int8: bool = False):
         import jax
 
         _enable_compilation_cache()
@@ -127,6 +128,7 @@ class ServingCell:
         self.engine = ServingEngine(
             cfg, params, mesh, num_slots=num_slots,
             max_seq_len=max_seq_len or min(cfg.max_seq_len, 4096),
+            kv_cache_int8=kv_cache_int8,
         )
         from kukeon_tpu.serving.tokenizer import load_tokenizer
 
@@ -358,6 +360,7 @@ def main(argv=None) -> int:
     ap.add_argument("--max-seq-len", type=int, default=None)
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--dtype", default=None)
+    ap.add_argument("--kv-cache-int8", action="store_true")
     ap.add_argument("--no-warmup", action="store_true")
     args = ap.parse_args(argv)
 
@@ -371,6 +374,7 @@ def main(argv=None) -> int:
         cell = ServingCell(
             args.model, num_slots=args.num_slots, max_seq_len=args.max_seq_len,
             checkpoint=args.checkpoint, dtype=args.dtype,
+            kv_cache_int8=args.kv_cache_int8,
         )
         # Warmup before the engine thread starts: step() is single-driver.
         if not args.no_warmup:

@@ -128,6 +128,7 @@ class ServingEngine:
         decode_chunk: int = 16,
         seed: int = 0,
         int8_pallas: bool | None = None,
+        kv_cache_int8: bool = False,
     ):
         # int8_pallas=None -> auto: route quantized decode matmuls through
         # the Pallas kernel on a single-chip TPU mesh when the operator opts
@@ -164,6 +165,11 @@ class ServingEngine:
         self.max_seq_len = max_seq_len or cfg.max_seq_len
         self.eos_ids = set(eos_ids)
         self.decode_chunk = max(1, decode_chunk)
+        # int8 KV cache: halves the cache's HBM bytes per decode step (the
+        # stream that grows with context length and slot count); dequant is
+        # fused into the decode attention dots. Prefill stays full-precision;
+        # quantization happens once, at slot insert.
+        self.kv_cache_int8 = kv_cache_int8
         self._key = jax.random.key(seed)
 
         if mesh is None:
@@ -191,7 +197,10 @@ class ServingEngine:
     # --- jitted programs ---------------------------------------------------
 
     def _init_state(self) -> DecodeState:
-        cache = llama.KVCache.create(self.cfg, self.num_slots, self.max_seq_len)
+        cache = llama.KVCache.create(
+            self.cfg, self.num_slots, self.max_seq_len,
+            quantized=self.kv_cache_int8,
+        )
         spec = shd.kv_cache_spec()
         tensor_size = self.mesh.shape.get(shd.AXIS_TENSOR, 1)
         if self.cfg.num_kv_heads % max(tensor_size, 1):
@@ -199,10 +208,16 @@ class ServingEngine:
             # (correct, just more HBM) instead of failing device_put.
             spec = PartitionSpec()
         kv_sharding = NamedSharding(self.mesh, spec)
+        # Scales [L, B, S, KV] shard like k/v minus the head_dim axis.
+        sc_sharding = NamedSharding(self.mesh, PartitionSpec(*spec[:4]))
         cache = llama.KVCache(
             k=jax.device_put(cache.k, kv_sharding),
             v=jax.device_put(cache.v, kv_sharding),
             lengths=cache.lengths,
+            k_scale=(jax.device_put(cache.k_scale, sc_sharding)
+                     if cache.k_scale is not None else None),
+            v_scale=(jax.device_put(cache.v_scale, sc_sharding)
+                     if cache.v_scale is not None else None),
         )
         return DecodeState(
             cache=cache,
@@ -226,11 +241,25 @@ class ServingEngine:
             return first, cache.k, cache.v
 
         def insert(state: DecodeState, kv_k, kv_v, length, slot, token):
-            """Copy a prefill's KV block into ``slot`` and activate it."""
+            """Copy a prefill's KV block into ``slot`` and activate it.
+
+            Prefill produces full-precision K/V (its self-attention is
+            exact); a quantized state cache quantizes the block here, once,
+            as it lands in the slot."""
+            ks = vs = None
+            if state.cache.quantized:
+                kv_k, ks = llama.quantize_kv(kv_k)   # [L, 1, S, KV(, D)]
+                kv_v, vs = llama.quantize_kv(kv_v)
             k = jax.lax.dynamic_update_slice(state.cache.k, kv_k, (0, slot, 0, 0, 0))
             v = jax.lax.dynamic_update_slice(state.cache.v, kv_v, (0, slot, 0, 0, 0))
             cache = llama.KVCache(
-                k=k, v=v, lengths=state.cache.lengths.at[slot].set(length)
+                k=k, v=v, lengths=state.cache.lengths.at[slot].set(length),
+                k_scale=(jax.lax.dynamic_update_slice(
+                    state.cache.k_scale, ks, (0, slot, 0, 0))
+                    if ks is not None else state.cache.k_scale),
+                v_scale=(jax.lax.dynamic_update_slice(
+                    state.cache.v_scale, vs, (0, slot, 0, 0))
+                    if vs is not None else state.cache.v_scale),
             )
             return DecodeState(
                 cache=cache,
@@ -254,8 +283,8 @@ class ServingEngine:
                     params, cfg, tokens, positions, state.cache
                 )
                 # Inactive slots must not advance their cache length.
-                cache = llama.KVCache(
-                    k=cache.k, v=cache.v,
+                cache = dataclasses.replace(
+                    cache,
                     lengths=jnp.where(state.active, cache.lengths, lengths_before),
                 )
                 key, k1 = jax.random.split(key)

@@ -78,3 +78,49 @@ def test_causality(tiny):
         np.asarray(logits_a[:, :-1]), np.asarray(logits_b[:, :-1]), rtol=1e-5, atol=1e-5
     )
     assert not np.allclose(np.asarray(logits_a[:, -1]), np.asarray(logits_b[:, -1]))
+
+
+def test_int8_kv_cache_decode_tracks_full_forward(tiny):
+    """Quantized-cache prefill + decode must track the exact full forward
+    within int8 quantization noise (per-token per-head scales keep the
+    relative error ~0.4% per element)."""
+    cfg, params = tiny
+    B, S = 2, 12
+    prefill_len = 8
+    tokens = jax.random.randint(jax.random.key(7), (B, S), 0, cfg.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+
+    full_logits, _ = llama.forward(params, cfg, tokens, positions)
+
+    cache = llama.KVCache.create(cfg, B, max_len=32, quantized=True)
+    assert cache.quantized and cache.k.dtype == jnp.int8
+    logits_p, cache = llama.forward(
+        params, cfg, tokens[:, :prefill_len], positions[:, :prefill_len], cache
+    )
+    assert cache.k.dtype == jnp.int8 and cache.k_scale.dtype == jnp.float32
+
+    got = [np.asarray(logits_p[:, t]) for t in range(prefill_len)]
+    for t in range(prefill_len, S):
+        logits_t, cache = llama.forward(
+            params, cfg, tokens[:, t : t + 1], positions[:, t : t + 1], cache
+        )
+        got.append(np.asarray(logits_t[:, 0]))
+    assert int(cache.lengths[0]) == S
+
+    want = np.asarray(full_logits)
+    for t in range(S):
+        a, b = got[t].ravel(), want[:, t].ravel()
+        cos = np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b))
+        assert cos > 0.999, f"step {t}: cosine {cos}"
+        np.testing.assert_allclose(a, b, rtol=0.08, atol=0.08)
+
+
+def test_int8_kv_roundtrip_error_bounded(tiny):
+    cfg, _ = tiny
+    x = jax.random.normal(jax.random.key(9), (2, 16, cfg.num_kv_heads, 32))
+    q, s = llama.quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == x.shape[:-1]
+    back = np.asarray(q, np.float32) * np.asarray(s)[..., None]
+    err = np.abs(back - np.asarray(x))
+    # Symmetric int8 rounding error <= scale/2 per element.
+    assert (err <= np.asarray(s)[..., None] / 2 + 1e-6).all()
