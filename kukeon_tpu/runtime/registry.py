@@ -240,6 +240,88 @@ class RegistryClient:
                 f"blob {digest}: digest mismatch (got sha256:{got})"
             )
 
+    # --- push ---------------------------------------------------------------
+
+    def _send(self, method: str, path_or_url: str, data=None,
+              content_type: str | None = None, timeout: int = 300,
+              retry_auth: bool = True, ok_codes: tuple[int, ...] = ()):
+        """Non-GET request with the shared auth story. ``data`` may be bytes
+        or a seekable file object (streamed, Content-Length from its size).
+        Returns (status, headers). HTTP errors whose code is in ``ok_codes``
+        are returned instead of raised (HEAD-existence probes)."""
+        url = (path_or_url if path_or_url.startswith("http")
+               else self._url(path_or_url))
+        path = urllib.parse.urlsplit(url).path
+        req = urllib.request.Request(url, method=method)
+        if content_type:
+            req.add_header("Content-Type", content_type)
+        if data is not None and hasattr(data, "seek"):
+            data.seek(0, os.SEEK_END)
+            req.add_header("Content-Length", str(data.tell()))
+            data.seek(0)
+        if data is not None:
+            req.data = data
+        for k, v in self.auth.headers().items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, dict(r.headers)
+        except urllib.error.HTTPError as e:
+            if e.code == 401 and retry_auth and self.auth.handle_challenge(
+                e.headers.get("WWW-Authenticate", "")
+            ):
+                if data is not None and hasattr(data, "seek"):
+                    data.seek(0)
+                return self._send(method, path_or_url, data, content_type,
+                                  timeout, retry_auth=False, ok_codes=ok_codes)
+            if e.code in ok_codes:
+                return e.code, dict(e.headers)
+            raise KukeonError(
+                f"registry {self.registry}: {method} {path} -> {e.code}"
+            ) from None
+        except urllib.error.URLError as e:
+            raise KukeonError(f"registry {self.registry}: {e.reason}") from None
+
+    def blob_exists(self, repo: str, digest: str) -> bool:
+        status, _ = self._send("HEAD", f"/v2/{repo}/blobs/{digest}",
+                               ok_codes=(404,))
+        return status == 200
+
+    def upload_blob(self, repo: str, digest: str, data) -> None:
+        """Monolithic blob upload: POST an upload session, PUT the bytes at
+        the returned Location with ?digest=. Skips blobs the registry
+        already has (cross-push dedup, the registry's content store is
+        content-addressed)."""
+        if self.blob_exists(repo, digest):
+            return
+        status, headers = self._send("POST", f"/v2/{repo}/blobs/uploads/",
+                                     data=b"")
+        loc = headers.get("Location") or headers.get("location")
+        if status not in (201, 202) or not loc:
+            raise KukeonError(
+                f"registry {self.registry}: upload session for {repo} "
+                f"refused (status {status}, no Location)"
+            )
+        loc = urllib.parse.urljoin(self._url("/"), loc)
+        sep = "&" if "?" in loc else "?"
+        url = loc + sep + urllib.parse.urlencode({"digest": digest})
+        status, _ = self._send("PUT", url, data=data,
+                               content_type="application/octet-stream")
+        if status not in (201, 204):
+            raise KukeonError(
+                f"registry {self.registry}: blob {digest} PUT -> {status}"
+            )
+
+    def put_manifest(self, repo: str, reference: str, body: bytes,
+                     media_type: str) -> None:
+        status, _ = self._send("PUT", f"/v2/{repo}/manifests/{reference}",
+                               data=body, content_type=media_type)
+        if status not in (201, 202):
+            raise KukeonError(
+                f"registry {self.registry}: manifest {repo}:{reference} "
+                f"PUT -> {status}"
+            )
+
 
 def _apply_layer(rootfs: str, tar_file, media_type: str) -> None:
     """Extract one layer over the rootfs with OCI whiteout semantics:
