@@ -457,7 +457,9 @@ def cmd_get(args):
                     else:
                         sync = "-"
                     conts = ",".join(
-                        f"{cs['name']}:{cs['state']}" for cs in st["containers"]
+                        f"{cs['name']}:{cs['state']}"
+                        + (f"(x{cs['restarts']})" if cs.get("restarts") else "")
+                        for cs in st["containers"]
                     )
                     print(fmt.format(r["name"], st["phase"], scope, chips, sync, conts))
     elif kind in ("secrets", "secret"):

@@ -176,7 +176,13 @@ class Controller:
         rec, _ = self.runner.refresh_cell(realm, space, stack, name)
         if rec is None:
             raise NotFound(f"cell {realm}/{space}/{stack}/{name} not found")
-        return rec.to_json()
+        d = rec.to_json()
+        # Live resource usage per container (reference: cgroup/task metrics
+        # surfaced through `kuke status`/`get`, internal/ctr/cgroups.go:484).
+        metrics = self.runner.cell_metrics(rec)
+        if metrics:
+            d["metrics"] = metrics
+        return d
 
     def list_cells(self, realm: str, space: str | None = None,
                    stack: str | None = None) -> list[dict]:

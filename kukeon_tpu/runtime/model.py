@@ -38,6 +38,10 @@ class ContainerStatus:
     started_at: float | None = None
     finished_at: float | None = None
     last_restart_at: float | None = None
+    # Tail of the container's log at its last non-clean exit — the operator's
+    # answer to "why is this cell cycling" straight from `kuke get` (reference:
+    # markCellFailed with reason, runner/start.go:186,414).
+    last_error: str | None = None
 
 
 @dataclass

@@ -748,6 +748,37 @@ class Runner:
                 st.exit_code = live.exit_code
                 if live.exited and st.finished_at is None:
                     st.finished_at = time.time()
+                if live.exited and (live.exit_code or 0) != 0:
+                    # Capture WHY before the restart path wipes the run
+                    # artifacts: the log tail at a non-clean exit is the
+                    # operator's only evidence in a crash loop (reference:
+                    # markCellFailed with reason, runner/start.go:186,414).
+                    tail = self._container_log_tail(ctx)
+                    if tail:
+                        st.last_error = tail
+                        changed = True
+
+            if live.running:
+                # Restart-budget replenishment: a container that has stayed
+                # up for a healthy-uptime window earns its budget back, so a
+                # bounded `restartMaxRetries` guards against crash LOOPS, not
+                # against a month of uptime with occasional crashes
+                # (reference keeps a windowed restart-state map,
+                # runner/refresh.go:1224-1458).
+                anchor = st.last_restart_at or st.started_at
+                if (
+                    st.restarts > 0
+                    and anchor is not None
+                    and (time.time() - anchor) >= self.RESTART_RESET_UPTIME_S
+                ):
+                    st.restarts = 0
+                    st.last_error = None
+                    changed = True
+                    # The crash is history now; stop alarming the operator.
+                    if rec.status.reason and rec.status.reason.startswith(
+                        f"container {spec.name} crash"
+                    ):
+                        rec.status.reason = None
 
             if (
                 rec.desired_state == "running"
@@ -762,6 +793,7 @@ class Runner:
                     ctx_full.env.update(self.devices.visibility_env(grant))
                     ctx_full.devices = self.devices.device_nodes(grant)
                 self.backend.start_container(ctx_full)
+                prev_exit = st.exit_code
                 live = self.backend.container_state(ctx_full)
                 st.state = live.state
                 st.pid = live.pid
@@ -769,8 +801,31 @@ class Runner:
                 st.restarts += 1
                 st.last_restart_at = time.time()
                 st.finished_at = None
+                if (prev_exit or 0) != 0:
+                    why = f": {st.last_error}" if st.last_error else ""
+                    rec.status.reason = (
+                        f"container {spec.name} crashed (exit {prev_exit}, "
+                        f"restart #{st.restarts}){why}"
+                    )
                 outcome = OUTCOME_RESTARTED
                 changed = True
+            elif (
+                rec.desired_state == "running"
+                and live.exited
+                and (st.exit_code or 0) != 0
+                and spec.restart_policy.policy != "never"
+                and spec.restart_policy.max_retries is not None
+                and st.restarts >= spec.restart_policy.max_retries
+            ):
+                why = f": {st.last_error}" if st.last_error else ""
+                reason = (
+                    f"container {spec.name} crash-looped: restart budget "
+                    f"exhausted ({st.restarts}/{spec.restart_policy.max_retries}, "
+                    f"last exit {st.exit_code}){why}"
+                )
+                if rec.status.reason != reason:
+                    rec.status.reason = reason
+                    changed = True
 
         # AutoDelete: reap once every container has exited
         # (reference: runner/runner.go:33-45).
@@ -798,6 +853,44 @@ class Runner:
             if outcome == OUTCOME_STEADY:
                 outcome = OUTCOME_HEALED
         return rec, outcome
+
+    # Continuous uptime after which a container's restart count resets.
+    RESTART_RESET_UPTIME_S = 300.0
+
+    def _container_log_tail(self, ctx: ContainerContext, limit: int = 500) -> str | None:
+        """Last few lines of the container's log (shim log, or the capture
+        transcript for attachable containers) for crash-reason reporting."""
+        names = [consts.CAPTURE_FILE] if ctx.spec.attachable else [consts.SHIM_LOG]
+        for name in names:
+            path = os.path.join(ctx.container_dir, name)
+            try:
+                with open(path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - 4096))
+                    data = f.read().decode(errors="replace")
+            except OSError:
+                continue
+            lines = [ln.strip() for ln in data.splitlines() if ln.strip()]
+            if lines:
+                return "\n".join(lines[-6:])[-limit:]
+        return None
+
+    def cell_metrics(self, rec: model.CellRecord) -> dict[str, dict]:
+        """Live per-container cgroup metrics (memory_bytes, cpu_usec, pids)
+        for `kuke get`/`status` (reference: internal/ctr/cgroups.go:484,
+        task.go:50 feed cgroup/task metrics into status). Read-only: never
+        creates cgroups, returns {} when the tree isn't managed."""
+        if not self.cgroups:
+            return {}
+        out: dict[str, dict] = {}
+        for spec in self.cell_containers(rec):
+            d = self.cgroups.path(rec.realm, rec.space, rec.stack, rec.name, spec.name)
+            if os.path.isdir(d):
+                m = self.cgroups.metrics(d)
+                if m:
+                    out[spec.name] = m
+        return out
 
     def _restart_due(self, spec: t.ContainerSpec, st: model.ContainerStatus) -> bool:
         rp = spec.restart_policy

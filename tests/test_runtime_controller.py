@@ -454,3 +454,101 @@ def test_out_of_sync_does_not_resurrect_auto_deleted_cell(ctl):
     # And it stays gone on the next tick.
     c.reconcile_cells()
     assert not store.cell_exists("default", "default", "default", "ghost")
+
+
+# --- crash-loop visibility + restart-budget replenishment (VERDICT r4 5/8) --
+
+
+def test_crash_reason_and_last_error_surface(ctl):
+    """A crashing container's log tail lands in container.lastError and the
+    cell reason (reference: markCellFailed with reason, runner/start.go)."""
+    import os
+
+    c, backend, store, _ = ctl
+    doc = _cell_doc()
+    doc.spec.containers[0].restart_policy = t.RestartPolicy(
+        policy="always", backoff_seconds=0.0, max_retries=2
+    )
+    c.create_cell(doc)
+    cdir = store.container_dir("default", "default", "default", "c1", "main")
+    with open(os.path.join(cdir, consts.SHIM_LOG), "w") as f:
+        f.write("loading model...\nTraceback (most recent call last):\n"
+                "RuntimeError: libtpu version mismatch\n")
+    backend.exit(cdir, 1)
+
+    _, outcome = c.runner.refresh_cell("default", "default", "default", "c1")
+    assert outcome == OUTCOME_RESTARTED
+    got = c.get_cell("default", "default", "default", "c1")
+    cs = got["status"]["containers"][0]
+    assert "libtpu version mismatch" in (cs["lastError"] or "")
+    assert "crashed (exit 1" in (got["status"]["reason"] or "")
+
+    # Exhaust the budget: the reason now names the exhausted budget.
+    backend.exit(cdir, 1)
+    c.runner.refresh_cell("default", "default", "default", "c1")
+    backend.exit(cdir, 1)
+    c.runner.refresh_cell("default", "default", "default", "c1")
+    got = c.get_cell("default", "default", "default", "c1")
+    assert "restart budget exhausted" in got["status"]["reason"]
+    assert got["status"]["phase"] == model.FAILED
+
+
+def test_restart_budget_replenishes_after_healthy_uptime(ctl):
+    """Healthy uptime resets the restart count so bounded maxRetries guards
+    crash LOOPS, not lifetime crash totals (refresh.go:1224-1458 analog)."""
+    c, backend, store, _ = ctl
+    doc = _cell_doc()
+    doc.spec.containers[0].restart_policy = t.RestartPolicy(
+        policy="always", backoff_seconds=0.0, max_retries=1
+    )
+    c.create_cell(doc)
+    cdir = store.container_dir("default", "default", "default", "c1", "main")
+    backend.exit(cdir, 1)
+    _, outcome = c.runner.refresh_cell("default", "default", "default", "c1")
+    assert outcome == OUTCOME_RESTARTED
+    rec = store.read_cell("default", "default", "default", "c1")
+    assert rec.status.container("main").restarts == 1
+
+    # Budget exhausted: another crash would NOT restart...
+    # ...but a healthy-uptime window replenishes it first.
+    c.runner.RESTART_RESET_UPTIME_S = 0.0
+    c.runner.refresh_cell("default", "default", "default", "c1")
+    rec = store.read_cell("default", "default", "default", "c1")
+    assert rec.status.container("main").restarts == 0
+
+    backend.exit(cdir, 1)
+    _, outcome = c.runner.refresh_cell("default", "default", "default", "c1")
+    assert outcome == OUTCOME_RESTARTED
+
+
+def test_get_cell_surfaces_cgroup_metrics(tmp_path):
+    """kuke get cell -o json shows live memory/cpu per container
+    (reference: internal/ctr/cgroups.go:484 feeding status)."""
+    import os
+
+    from kukeon_tpu.runtime.cgroups import CgroupManager
+    from kukeon_tpu.runtime.metadata import MetadataStore
+
+    croot = tmp_path / "cgroup"
+    croot.mkdir()
+    (croot / "cgroup.controllers").write_text("cpu memory pids\n")
+    store = ResourceStore(MetadataStore(str(tmp_path / "run")))
+    backend = FakeBackend()
+    runner = Runner(store, backend, cgroups=CgroupManager(root=str(croot)),
+                    devices=TPUDeviceManager(store.ms, chips=[0]),
+                    options=RunnerOptions(stop_grace_s=0.2))
+    c = Controller(store, runner)
+    c.bootstrap()
+    c.create_cell(_cell_doc())
+
+    leaf = croot / "kukeon" / "default" / "default" / "default" / "c1" / "main"
+    assert leaf.is_dir()  # created at start by _container_context
+    (leaf / "memory.current").write_text("123456789\n")
+    (leaf / "pids.current").write_text("7\n")
+    (leaf / "cpu.stat").write_text("usage_usec 4242\nuser_usec 4000\n")
+
+    got = c.get_cell("default", "default", "default", "c1")
+    m = got["metrics"]["main"]
+    assert m["memory_bytes"] == 123456789
+    assert m["pids"] == 7
+    assert m["cpu_usec"] == 4242
