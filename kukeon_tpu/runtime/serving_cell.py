@@ -31,23 +31,60 @@ MODELS = {}
 EMBEDDING_MODELS = {}
 
 
+_CACHE_DIR: str | None = None   # the versioned dir actually configured
+
+
 def _enable_compilation_cache() -> None:
     """Persistent XLA compilation cache: the dominant cold-start cost after
     weight load is jit compilation; caching it on disk makes every boot
     after the first (same program shapes) start in seconds. Standard TPU
-    serving practice (JetStream does the same)."""
+    serving practice (JetStream does the same).
+
+    The cache dir is keyed by the runtime build (jax version + backend
+    platform_version, which embeds the libtpu build stamp): AOT artifacts
+    compiled under one libtpu are invalid under another — r4's cold-start
+    died to exactly this ("FAILED_PRECONDITION: libtpu version mismatch"
+    crash loop off stale cache entries after a libtpu roll). A rolled
+    runtime must see an EMPTY cache, never a poisoned one."""
+    global _CACHE_DIR
+    import hashlib
+
     import jax
 
-    cache_dir = os.environ.get(
+    base = os.environ.get(
         "KUKEON_JAX_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "kukeon-jax"),
     )
     try:
+        try:
+            import jax.extend
+
+            ver = jax.extend.backend.get_backend().platform_version
+        except Exception:  # noqa: BLE001 — version probe must not kill serving
+            ver = "unknown"
+        key = hashlib.sha256(f"{jax.__version__}|{ver}".encode()).hexdigest()[:12]
+        cache_dir = os.path.join(base, key)
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _CACHE_DIR = cache_dir
     except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
         pass
+
+
+def _bust_compilation_cache() -> bool:
+    """Wipe the configured cache dir; True if there was anything to wipe.
+    Last-resort self-heal for a corrupted cache entry that keys identically
+    but fails to deserialize (crash-looping forever would be worse than one
+    slow recompile)."""
+    if not _CACHE_DIR or not os.path.isdir(_CACHE_DIR):
+        return False
+    import shutil
+
+    had = any(os.scandir(_CACHE_DIR))
+    shutil.rmtree(_CACHE_DIR, ignore_errors=True)
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    return had
 
 
 def _register_models():
@@ -62,20 +99,6 @@ def _register_models():
         "bge-base": bert.bge_base,
         "bge-tiny": bert.bge_tiny,
     })
-
-
-class ByteTokenizer:
-    """Fallback tokenizer: utf-8 bytes shifted by 1 (0 is pad)."""
-
-    def encode(self, text: str) -> list[int]:
-        return [b + 1 for b in text.encode()]
-
-    def decode(self, ids: list[int]) -> str:
-        # Ids beyond the byte range (random-init models sample the whole
-        # vocab) degrade to '?' rather than erroring.
-        return bytes(
-            (i - 1) if 0 < i <= 256 else 0x3F for i in ids if i > 0
-        ).decode(errors="replace")
 
 
 class ServingCell:
@@ -251,7 +274,12 @@ class EmbeddingCell:
         self.cfg = cfg
         self.engine = EmbeddingEngine(cfg, params, mesh,
                                       batch_size=batch_size, pooling=pooling)
-        self.tokenizer = ByteTokenizer()
+        # The checkpoint's real tokenizer when it ships one (BASELINE config
+        # 5 text inputs must not be byte-mangled for a real bge model);
+        # byte fallback otherwise — same rule as the decoder cell.
+        from kukeon_tpu.serving.tokenizer import load_tokenizer
+
+        self.tokenizer = load_tokenizer(checkpoint)
         self.started_at = time.time()
         self.total_sequences = 0
         self._stats_lock = threading.Lock()
@@ -365,12 +393,14 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     _register_models()
-    if args.model in EMBEDDING_MODELS:
-        cell = EmbeddingCell(args.model, batch_size=args.num_slots,
-                             checkpoint=args.checkpoint, dtype=args.dtype)
-        if not args.no_warmup:
-            cell.warmup()
-    else:
+
+    def build():
+        if args.model in EMBEDDING_MODELS:
+            cell = EmbeddingCell(args.model, batch_size=args.num_slots,
+                                 checkpoint=args.checkpoint, dtype=args.dtype)
+            if not args.no_warmup:
+                cell.warmup()
+            return cell
         cell = ServingCell(
             args.model, num_slots=args.num_slots, max_seq_len=args.max_seq_len,
             checkpoint=args.checkpoint, dtype=args.dtype,
@@ -380,6 +410,21 @@ def main(argv=None) -> int:
         if not args.no_warmup:
             cell.warmup()
         cell.engine.start()
+        return cell
+
+    try:
+        cell = build()
+    except Exception as e:  # noqa: BLE001 — one self-heal attempt
+        # A poisoned persistent-cache entry (stale AOT vs rolled libtpu,
+        # truncated write) would otherwise crash-loop the cell forever under
+        # restartPolicy: always. Bust the cache and recompile once; rethrow
+        # if the failure had nothing to do with the cache.
+        if not _bust_compilation_cache():
+            raise
+        print(f"serving-cell: init failed ({type(e).__name__}: {e}); "
+              "busted persistent compilation cache, retrying once",
+              file=sys.stderr, flush=True)
+        cell = build()
     server = ThreadingHTTPServer((args.host, args.port), make_handler(cell))
     print(f"serving-cell: {args.model} ready on {args.host}:{args.port}", flush=True)
     try:

@@ -34,9 +34,11 @@ class HFTokenizer:
 
         self.tk = Tokenizer.from_file(path)
         self.vocab_size = self.tk.get_vocab_size()
-        self.bos_id = self._special("<|begin_of_text|>", "<s>", "<bos>")
+        # [CLS]/[SEP] cover BERT-family tokenizers (bge embedding models):
+        # prepending [CLS] is what makes CLS-pooling meaningful.
+        self.bos_id = self._special("<|begin_of_text|>", "<s>", "<bos>", "[CLS]")
         self.eos_id = self._special("<|end_of_text|>", "</s>", "<eos>",
-                                    "<|eot_id|>")
+                                    "<|eot_id|>", "[SEP]")
 
     def _special(self, *names: str) -> int | None:
         for name in names:
