@@ -210,17 +210,62 @@ def phase_serve(args) -> None:
 
 # --- cold-start phase ---------------------------------------------------------
 
+def _tail_file(path: str, limit: int = 2500) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - limit))
+            return f.read().decode(errors="replace")
+    except OSError:
+        return f"<unreadable: {path}>"
+
+
+def _dump_evidence(run_path: str, daemon_log: str, cli: list[str],
+                   socket_path: str, env: dict, run: int) -> None:
+    """Preserve the crime scene on stderr BEFORE cleanup destroys it
+    (VERDICT r4 weak 2: r4's cold-start failure was undiagnosable because
+    rmtree ran before anything read the model-server log; the reference's
+    e2e harness preserves daemon evidence — harness_daemon_test.go:26-60)."""
+    import glob
+
+    _log(f"=== cold-start run {run} evidence ===")
+    try:
+        got = subprocess.run(
+            cli + ["--socket", socket_path, "--run-path", run_path,
+                   "get", "cell", "llm", "--json"],
+            env=env, capture_output=True, text=True, timeout=30,
+        )
+        _log("kuke get cell llm --json:\n" + (got.stdout or got.stderr)[-3000:])
+    except Exception as e:  # noqa: BLE001 — evidence is best-effort
+        _log(f"kuke get failed: {e}")
+    for pattern, label in (
+        (os.path.join(run_path, "**", "model-server", "container.log"),
+         "model-server container.log"),
+        (daemon_log, "daemon log"),
+    ):
+        paths = glob.glob(pattern, recursive=True) if "*" in pattern else [pattern]
+        for p in paths:
+            _log(f"--- {label} tail ({p}) ---\n{_tail_file(p)}")
+    _log(f"=== end evidence (run {run}) ===")
+
+
 def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
-                        chips: str) -> list[float]:
+                        chips: str) -> tuple[list[float], list[str]]:
     """N x [fresh daemon -> kuke apply model-cell manifest -> first
     /v1/health 200]. The daemon and model server are real subprocesses on
     the real CLI path (VERDICT item 2: 'time kuke apply of a model-cell
-    manifest -> first /v1/health 200')."""
+    manifest -> first /v1/health 200').
+
+    Never raises: returns (times, errors). A failed run dumps the
+    model-server + daemon logs to stderr before its run path is removed."""
     cli = [sys.executable, "-m", "kukeon_tpu.runtime.cli"]
     times: list[float] = []
+    errors: list[str] = []
     for run in range(runs):
         run_path = tempfile.mkdtemp(prefix="kuke-bench-")
         socket_path = f"/tmp/kuked-bench-{uuid.uuid4().hex[:8]}.sock"
+        daemon_log = os.path.join(run_path, "kukeond.log")
         port = 9600 + run
         env = subprocess_env()
         env.update({
@@ -240,11 +285,12 @@ def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
             + (f", checkpoint: {checkpoint}" if checkpoint else "")
             + ", maxSeqLen: 1024, hostNetwork: true}\n"
         )
-        daemon = subprocess.Popen(
-            cli + ["daemon", "serve", "--run-path", run_path,
-                   "--socket", socket_path],
-            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        )
+        with open(daemon_log, "wb") as dlog:
+            daemon = subprocess.Popen(
+                cli + ["daemon", "serve", "--run-path", run_path,
+                       "--socket", socket_path],
+                env=env, stdout=dlog, stderr=subprocess.STDOUT,
+            )
         try:
             deadline = time.monotonic() + 15
             while not os.path.exists(socket_path):
@@ -259,7 +305,8 @@ def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
                 capture_output=True, timeout=120,
             )
             health = f"http://127.0.0.1:{port}/v1/health"
-            deadline = time.monotonic() + 600
+            budget = float(os.environ.get("KUKEON_BENCH_HEALTH_TIMEOUT", "600"))
+            deadline = time.monotonic() + budget
             while True:
                 try:
                     with urllib.request.urlopen(health, timeout=2) as r:
@@ -268,7 +315,9 @@ def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
                 except OSError:
                     pass
                 if time.monotonic() > deadline:
-                    raise RuntimeError(f"model cell not healthy in 600s (run {run})")
+                    raise RuntimeError(
+                        f"model cell not healthy in {budget:.0f}s (run {run})"
+                    )
                 time.sleep(0.25)
             dt = time.monotonic() - t0
             times.append(dt)
@@ -278,6 +327,10 @@ def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
                        "delete", "cell", "llm", "--force"],
                 env=env, capture_output=True, timeout=120,
             )
+        except Exception as e:  # noqa: BLE001 — a lost run must not lose the bench
+            errors.append(f"run {run}: {e}")
+            _log(f"cold start run {run} FAILED: {e}")
+            _dump_evidence(run_path, daemon_log, cli, socket_path, env, run)
         finally:
             daemon.terminate()
             try:
@@ -289,7 +342,7 @@ def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
             shutil.rmtree(run_path, ignore_errors=True)
             if os.path.exists(socket_path):
                 os.unlink(socket_path)
-    return times
+    return times, errors
 
 
 # --- orchestrator -------------------------------------------------------------
@@ -314,35 +367,49 @@ def main() -> None:
     backend, n_chips = detect_backend()
     _log(f"backend={backend} n_chips={n_chips}")
 
-    if backend == "cpu":
-        qdir = None
+    qdir = None
+    if backend != "cpu":
+        try:
+            qdir = ensure_quantized_8b()
+        except Exception as e:  # noqa: BLE001 — degrade, don't die numberless
+            _log(f"8B checkpoint prep failed ({e}); degrading to CPU smoke")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            backend = "cpu"
+    cold_model, cold_runs = ("llama3-8b", 3) if qdir else ("tiny", 1)
+
+    def run_serve(checkpoint: str | None):
+        # Serve phase in its own process (exits -> releases the chip for
+        # the cold-start daemons).
+        cmd = [sys.executable, os.path.abspath(__file__), "--phase", "serve",
+               "--decode-chunk", str(args.decode_chunk)]
+        if args.kv_int8:
+            cmd += ["--kv-int8"]
+        if checkpoint:
+            cmd += ["--checkpoint", checkpoint]
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=3600, cwd=REPO, env=subprocess_env())
+        sys.stderr.write(out.stderr[-8000:])
+        if out.returncode != 0:
+            raise RuntimeError(f"serve phase rc={out.returncode}")
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    try:
+        serve = run_serve(qdir)
+    except Exception as e:  # noqa: BLE001 — a TPU serve failure must not zero the bench
+        if backend == "cpu":
+            raise
+        _log(f"TPU serve phase failed ({e}); falling back to CPU smoke")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        backend, qdir = "cpu", None
         cold_model, cold_runs = "tiny", 1
-    else:
-        qdir = ensure_quantized_8b()
-        cold_model, cold_runs = "llama3-8b", 3
-
-    # Serve phase in its own process (exits -> releases the chip for the
-    # cold-start daemons).
-    serve_cmd = [sys.executable, os.path.abspath(__file__), "--phase", "serve",
-                 "--decode-chunk", str(args.decode_chunk)]
-    if qdir:
-        serve_cmd += ["--checkpoint", qdir]
-    out = subprocess.run(serve_cmd, capture_output=True, text=True,
-                         timeout=3600, cwd=REPO, env=subprocess_env())
-    if out.returncode != 0:
-        raise RuntimeError(f"serve phase failed:\n{out.stderr[-4000:]}")
-    sys.stderr.write(out.stderr)
-    serve = json.loads(out.stdout.strip().splitlines()[-1])
-
-    cold_runs_s = measure_cold_starts(
-        cold_model, qdir, cold_runs,
-        chips=os.environ.get("KUKEON_TPU_CHIPS", "0"),
-    )
-    cold_runs_s.sort()
-    p50 = cold_runs_s[len(cold_runs_s) // 2]
+        serve = run_serve(None)
+    # Bank the measured number the moment it exists: everything after this
+    # point appends to the result, never destroys it (VERDICT r4 weak 1 —
+    # r4's measured 8B TPU throughput was discarded when cold-start raised).
+    _log(f"serve phase result: {json.dumps(serve)}")
 
     baseline_share = 1500.0 * serve["n_chips"] / 8.0
-    print(json.dumps({
+    result = {
         "metric": "aggregate decode tok/s, %d concurrent sessions, %s, %d chip(s) [%s]"
                   % (serve["sessions"], serve["model"], serve["n_chips"],
                      serve["backend"]),
@@ -350,13 +417,27 @@ def main() -> None:
         "unit": "tok/s",
         "vs_baseline": round(serve["tok_per_s"] / baseline_share, 4),
         "trials": serve["trials"],
-        "cold_start": {
-            "p50_s": round(p50, 1),
-            "target_s": COLD_START_TARGET_S,
-            "runs_s": [round(t, 1) for t in cold_runs_s],
-            "model": cold_model,
-        },
-    }))
+    }
+
+    try:
+        cold_runs_s, cold_errors = measure_cold_starts(
+            cold_model, qdir, cold_runs,
+            chips=os.environ.get("KUKEON_TPU_CHIPS", "0"),
+        )
+    except Exception as e:  # noqa: BLE001 — belt over measure's own no-raise
+        cold_runs_s, cold_errors = [], [f"harness: {e}"]
+    cold: dict = {
+        "target_s": COLD_START_TARGET_S,
+        "runs_s": [round(t, 1) for t in sorted(cold_runs_s)],
+        "model": cold_model,
+    }
+    if cold_runs_s:
+        s = sorted(cold_runs_s)
+        cold["p50_s"] = round(s[len(s) // 2], 1)
+    if cold_errors:
+        cold["error"] = "; ".join(cold_errors)[-500:]
+    result["cold_start"] = cold
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
