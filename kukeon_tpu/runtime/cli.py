@@ -125,6 +125,13 @@ def cmd_image(args):
         m = c.call("PullImage", ref=args.ref,
                    insecure=True if args.insecure else None)
         print(f"image/{m['name']}:{m['tag']}: pulled")
+    elif sub == "push":
+        if not args.ref:
+            print("error: image push needs a local image ref", file=sys.stderr)
+            return 2
+        pushed = c.call("PushImage", ref=args.ref, dest=args.to,
+                        insecure=True if args.insecure else None)
+        print(f"image/{args.ref}: pushed to {pushed}")
     elif sub == "save":
         c.call("SaveImage", ref=args.ref, tarPath=os.path.abspath(args.output))
         print(f"image/{args.ref}: saved to {args.output}")
@@ -161,6 +168,7 @@ def cmd_team(args):
         return c.call("ApplyDocuments", yaml=blob, team=team, prune=prune)
 
     builder = None
+    pusher = None
     if args.build:
         try:
             from kukeon_tpu.runtime.images import ImageBuilder, ImageStore
@@ -169,6 +177,14 @@ def cmd_team(args):
                   "run team init without --build", file=sys.stderr)
             return 1
         builder = ImageBuilder(ImageStore(_run_path(args)))
+    if getattr(args, "push", False):
+        from kukeon_tpu.runtime import registry as regmod
+        from kukeon_tpu.runtime.images import ImageStore, split_ref
+
+        def pusher(tag, reg):
+            _, repo, t = regmod.parse_image_ref(tag)
+            return regmod.push(ImageStore(_run_path(args)), tag,
+                               dest=f"{reg}/{repo}:{t}")
     res = team_init(
         None if args.dry_run else apply_fn,
         args.file,
@@ -176,11 +192,14 @@ def cmd_team(args):
         dry_run=args.dry_run,
         build=args.build,
         builder=builder,
+        pusher=pusher,
     )
     print(f"team {res.project}: source at {res.checkout}")
     if res.built_images:
         for img in res.built_images:
             print(f"  built {img}")
+    for img in res.pushed_images:
+        print(f"  pushed {img}")
     if res.secret_names:
         print(f"  secrets: {', '.join(res.secret_names)}")
     if args.dry_run and res.rendered:
@@ -867,12 +886,15 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub_add("image")
     sp.add_argument("image_cmd",
                     choices=["list", "get", "delete", "prune", "load", "save",
-                             "pull"])
+                             "pull", "push"])
     sp.add_argument("ref", nargs="?", default=None)
     sp.add_argument("-i", "--input", default=None, help="tarball to load")
     sp.add_argument("-o", "--output", default=None, help="tarball to save to")
+    sp.add_argument("--to", default=None,
+                    help="push target registry/repo[:tag] (default: the "
+                         "image's own ref)")
     sp.add_argument("--insecure", action="store_true",
-                    help="pull over plain HTTP (implied for localhost)")
+                    help="pull/push over plain HTTP (implied for localhost)")
 
     sp = sub_add("build")
     sp.add_argument("context", nargs="?", default=".")
@@ -886,6 +908,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--dry-run", action="store_true")
     sp.add_argument("--build", action="store_true",
                     help="build catalog images before rendering")
+    sp.add_argument("--push", action="store_true",
+                    help="push built images to the teams-config registry "
+                         "(requires --build)")
 
     sp = sub_add("purge")
     sp.add_argument("kind")

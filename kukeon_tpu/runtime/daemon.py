@@ -305,6 +305,13 @@ class RPCService:
 
         return registry.pull(self._image_store(), ref, insecure=insecure).to_json()
 
+    def PushImage(self, ref: str, dest: str | None = None,
+                  insecure: bool | None = None) -> str:
+        from kukeon_tpu.runtime import registry
+
+        return registry.push(self._image_store(), ref, dest=dest,
+                             insecure=insecure)
+
     def SaveImage(self, ref: str, tarPath: str) -> None:
         self._image_store().save_tar(ref, tarPath)
 

@@ -248,39 +248,55 @@ class RegistryClient:
         """Non-GET request with the shared auth story. ``data`` may be bytes
         or a seekable file object (streamed, Content-Length from its size).
         Returns (status, headers). HTTP errors whose code is in ``ok_codes``
-        are returned instead of raised (HEAD-existence probes)."""
+        are returned instead of raised (HEAD-existence probes).
+
+        Built on http.client, NOT urllib.request: urllib silently replaces
+        an explicit Content-Length with Transfer-Encoding: chunked for file
+        bodies, and registries (monolithic upload is Content-Length-framed
+        in the distribution spec) then read chunk framing as blob bytes."""
+        import http.client
+
         url = (path_or_url if path_or_url.startswith("http")
                else self._url(path_or_url))
-        path = urllib.parse.urlsplit(url).path
-        req = urllib.request.Request(url, method=method)
+        split = urllib.parse.urlsplit(url)
+        path = split.path + (f"?{split.query}" if split.query else "")
+        headers: dict[str, str] = {}
         if content_type:
-            req.add_header("Content-Type", content_type)
+            headers["Content-Type"] = content_type
         if data is not None and hasattr(data, "seek"):
             data.seek(0, os.SEEK_END)
-            req.add_header("Content-Length", str(data.tell()))
+            headers["Content-Length"] = str(data.tell())
             data.seek(0)
-        if data is not None:
-            req.data = data
-        for k, v in self.auth.headers().items():
-            req.add_header(k, v)
+        elif data is not None:
+            headers["Content-Length"] = str(len(data))
+        # Auth only travels to the registry itself. Registries commonly
+        # redirect blob uploads to object storage via an absolute Location;
+        # forwarding Basic/Bearer there would hand credentials to a third
+        # party (docker-style clients strip auth on cross-host redirects).
+        if split.netloc == self.registry:
+            headers.update(self.auth.headers())
+        conn_cls = (http.client.HTTPSConnection if split.scheme == "https"
+                    else http.client.HTTPConnection)
+        conn = conn_cls(split.netloc, timeout=timeout)
         try:
-            with urllib.request.urlopen(req, timeout=timeout) as r:
-                return r.status, dict(r.headers)
-        except urllib.error.HTTPError as e:
-            if e.code == 401 and retry_auth and self.auth.handle_challenge(
-                e.headers.get("WWW-Authenticate", "")
-            ):
-                if data is not None and hasattr(data, "seek"):
-                    data.seek(0)
-                return self._send(method, path_or_url, data, content_type,
-                                  timeout, retry_auth=False, ok_codes=ok_codes)
-            if e.code in ok_codes:
-                return e.code, dict(e.headers)
+            conn.request(method, path, body=data, headers=headers)
+            r = conn.getresponse()
+            r.read()
+            status, rheaders = r.status, dict(r.getheaders())
+        except OSError as e:
+            raise KukeonError(f"registry {self.registry}: {e}") from None
+        finally:
+            conn.close()
+        if status == 401 and retry_auth and self.auth.handle_challenge(
+            rheaders.get("WWW-Authenticate", "")
+        ):
+            return self._send(method, path_or_url, data, content_type,
+                              timeout, retry_auth=False, ok_codes=ok_codes)
+        if status >= 400 and not (200 <= status < 400 or status in ok_codes):
             raise KukeonError(
-                f"registry {self.registry}: {method} {path} -> {e.code}"
-            ) from None
-        except urllib.error.URLError as e:
-            raise KukeonError(f"registry {self.registry}: {e.reason}") from None
+                f"registry {self.registry}: {method} {split.path} -> {status}"
+            )
+        return status, rheaders
 
     def blob_exists(self, repo: str, digest: str) -> bool:
         status, _ = self._send("HEAD", f"/v2/{repo}/blobs/{digest}",
@@ -321,6 +337,89 @@ class RegistryClient:
                 f"registry {self.registry}: manifest {repo}:{reference} "
                 f"PUT -> {status}"
             )
+
+
+def push(store: ImageStore, ref: str, *, dest: str | None = None,
+         insecure: bool | None = None) -> str:
+    """Push a local image to an OCI registry; returns the pushed ref.
+
+    ``dest`` (registry/repo[:tag]) overrides the target; without it the
+    image's own ref must name a registry host. The store keeps flattened
+    bundles, so the pushed image is a single gzip layer built from the
+    rootfs plus a config blob carrying entrypoint/cmd/env/workdir/labels —
+    a faithful round-trip through ``pull`` (reference: kukebuild pushes what
+    it builds, cmd/kukebuild/auth.go:125-154 resolving the push creds).
+    """
+    import gzip
+    import platform
+    import tempfile
+
+    m = store.get(ref)
+    target = dest or m.ref
+    registry_host, repo, tag = parse_image_ref(target)
+    client = RegistryClient(registry_host, insecure=insecure)
+    arch = {"x86_64": "amd64", "aarch64": "arm64"}.get(
+        platform.machine(), platform.machine()
+    )
+
+    def file_sha256(f) -> str:
+        f.seek(0)
+        h = hashlib.sha256()
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+        return "sha256:" + h.hexdigest()
+
+    with tempfile.TemporaryFile() as plain, tempfile.TemporaryFile() as zipped:
+        # Uncompressed tar first: its digest is the diff_id the config
+        # must carry (the content-addressed identity of the LAYER, not of
+        # the gzip stream around it).
+        with tarfile.open(fileobj=plain, mode="w") as tf:
+            tf.add(store.rootfs(m.ref), arcname=".")
+        diff_id = file_sha256(plain)
+        plain.seek(0)
+        # mtime=0 keeps the gzip stream (and so the blob digest) stable
+        # across re-pushes of identical content.
+        with gzip.GzipFile(fileobj=zipped, mode="wb", mtime=0) as gz:
+            shutil.copyfileobj(plain, gz)
+        layer_digest = file_sha256(zipped)
+        zipped.seek(0, os.SEEK_END)
+        layer_size = zipped.tell()
+
+        config = {
+            "architecture": arch,
+            "os": "linux",
+            "config": {
+                "Entrypoint": list(m.entrypoint),
+                "Cmd": list(m.cmd),
+                "Env": [f"{k}={v}" for k, v in sorted(m.env.items())],
+                "WorkingDir": m.workdir or "",
+                "Labels": dict(m.labels),
+            },
+            "rootfs": {"type": "layers", "diff_ids": [diff_id]},
+        }
+        cfg_bytes = json.dumps(config, sort_keys=True).encode()
+        cfg_digest = "sha256:" + hashlib.sha256(cfg_bytes).hexdigest()
+
+        manifest = json.dumps({
+            "schemaVersion": 2,
+            "mediaType": MT_OCI_MANIFEST,
+            "config": {
+                "mediaType": "application/vnd.oci.image.config.v1+json",
+                "digest": cfg_digest, "size": len(cfg_bytes),
+            },
+            "layers": [{
+                "mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+                "digest": layer_digest, "size": layer_size,
+            }],
+        }).encode()
+
+        client.upload_blob(repo, cfg_digest, cfg_bytes)
+        client.upload_blob(repo, layer_digest, zipped)
+        client.put_manifest(repo, tag, manifest, MT_OCI_MANIFEST)
+    return f"{registry_host}/{repo}:{tag}"
 
 
 def _apply_layer(rootfs: str, tar_file, media_type: str) -> None:

@@ -26,6 +26,7 @@ class TeamInitResult:
     rendered: RenderResult | None = None
     secret_names: list[str] = field(default_factory=list)
     built_images: list[str] = field(default_factory=list)
+    pushed_images: list[str] = field(default_factory=list)
 
 
 def load_project_team(path: str) -> tt.ProjectTeam:
@@ -41,12 +42,19 @@ def load_project_team(path: str) -> tt.ProjectTeam:
 
 def team_init(apply_fn, project_file: str, host: TeamHost | None = None,
               git: GitRunner | None = None, dry_run: bool = False,
-              build: bool = False, builder=None) -> TeamInitResult:
+              build: bool = False, builder=None,
+              pusher=None) -> TeamInitResult:
     """The full pipeline.
 
     ``apply_fn(yaml_blob, team, prune) -> list[dict]`` is the apply
     transport — an RPC client call or an in-process controller; None is
     allowed for dry runs.
+
+    ``pusher(tag, registry) -> pushed_ref`` pushes each built image to the
+    TeamsConfig's registry after the build (reference: teambuild threads the
+    REGISTRY build-arg AND kukebuild pushes with docker-config auth,
+    internal/teambuild/teambuild.go:17-42, cmd/kukebuild/auth.go:125-154).
+    Requires ``build`` and a non-empty ``registry:`` in the teams config.
     """
     host = host or TeamHost()
     team = load_project_team(project_file)
@@ -70,9 +78,20 @@ def team_init(apply_fn, project_file: str, host: TeamHost | None = None,
     if build:
         if builder is None:
             raise InvalidArgument("--build requires an image builder")
+        if pusher is not None and not cfg.registry:
+            raise InvalidArgument(
+                "--push requires a registry in the teams config "
+                "(~/.kuke/kuketeams.yaml: registry: host[:port])"
+            )
         result.built_images = build_team_images(
             builder, bundle, cfg, checkout
         )
+        if pusher is not None:
+            result.pushed_images = [
+                pusher(tag, cfg.registry) for tag in result.built_images
+            ]
+    elif pusher is not None:
+        raise InvalidArgument("--push requires --build")
 
     secret_values = load_team_secrets(host, cfg, team.name)
     realm = team.realm or consts.DEFAULT_REALM

@@ -53,13 +53,18 @@ class FakeRegistry:
     (list + image), blobs. Optionally requires Bearer auth."""
 
     def __init__(self, *, require_auth: bool = False,
-                 user: str = "kuke", password: str = "sekrit"):
+                 user: str = "kuke", password: str = "sekrit",
+                 upload_redirect_base: str | None = None):
         self.blobs: dict[str, bytes] = {}
         self.manifests: dict[tuple[str, str], tuple[bytes, str]] = {}
         self.require_auth = require_auth
         self.user, self.password = user, password
         self.token = "tok-" + hashlib.sha256(password.encode()).hexdigest()[:8]
         self.token_requests: list[str] = []
+        # Absolute base URL to redirect blob uploads to (the object-storage
+        # redirect pattern); None keeps uploads on this server.
+        self.upload_redirect_base = upload_redirect_base
+        self.upload_auth_seen: list[str | None] = []
 
         reg = self
 
@@ -120,6 +125,57 @@ class FakeRegistry:
                         self._send(200, blob,
                                    ctype="application/octet-stream")
                         return
+                self._send(404, b"{}")
+
+            # --- push endpoints --------------------------------------------
+
+            def do_HEAD(self):
+                parts = self.path.split("/")
+                if len(parts) >= 5 and parts[1] == "v2" and parts[-2] == "blobs":
+                    if parts[-1] in reg.blobs:
+                        self._send(200)
+                    else:
+                        self._send(404)
+                    return
+                self._send(404)
+
+            def do_POST(self):
+                # /v2/<repo>/blobs/uploads/ -> upload session Location
+                path = self.path.rstrip("/")
+                if path.endswith("/blobs/uploads"):
+                    repo = "/".join(path.split("/")[2:-2])
+                    base = reg.upload_redirect_base or ""
+                    self._send(202, headers=[
+                        ("Location", f"{base}/v2/{repo}/blobs/uploads/sess1"),
+                    ])
+                    return
+                self._send(404, b"{}")
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                reg.upload_auth_seen.append(self.headers.get("Authorization"))
+                split = self.path.split("?")[0].split("/")
+                if "uploads" in split:
+                    # blob PUT at the session Location with ?digest=
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    digest = q.get("digest", [""])[0]
+                    if _digest(body) != digest:
+                        self._send(400, b'{"error": "digest mismatch"}')
+                        return
+                    reg.blobs[digest] = body
+                    self._send(201)
+                    return
+                if len(split) >= 5 and split[1] == "v2" and split[-2] == "manifests":
+                    repo = "/".join(split[2:-2])
+                    tag = split[-1]
+                    mt = self.headers.get("Content-Type", "")
+                    reg.manifests[(repo, tag)] = (body, mt)
+                    reg.manifests[(repo, _digest(body))] = (body, mt)
+                    self._send(201)
+                    return
                 self._send(404, b"{}")
 
         self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
@@ -429,6 +485,77 @@ spec:
             reg.close()
 
 
+class TestPushE2E:
+    def test_kuke_build_push_pullback_run(self, tmp_path):
+        """Black-box round trip (VERDICT r4 item 6 'done' criterion):
+        `kuke build` an image -> `kuke image push` to a live local registry
+        -> delete local -> `kuke image pull` back -> a cell runs it."""
+        import subprocess
+        import sys
+        import time as _t
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_runtime_e2e import Daemon
+
+        ctx = tmp_path / "ctx"
+        ctx.mkdir()
+        (ctx / "hello.txt").write_text("pushed-bytes\n")
+        # Static binary: a from-scratch rootfs has no shell/cat to exec.
+        src = tmp_path / "cat.c"
+        src.write_text(
+            '#include <stdio.h>\n'
+            'int main(void) {\n'
+            '    FILE* f = fopen("/app/hello.txt", "r");\n'
+            '    if (!f) { printf("NOFILE\\n"); return 1; }\n'
+            '    char buf[64] = {0};\n'
+            '    fread(buf, 1, 63, f);\n'
+            '    printf("%s", buf);\n'
+            '    return 0;\n'
+            '}\n'
+        )
+        subprocess.run(["g++", "-static", "-O1", "-o", str(ctx / "catapp"),
+                        str(src)], check=True, capture_output=True)
+        (ctx / "Kukefile").write_text(
+            "FROM scratch\n"
+            "COPY hello.txt /app/hello.txt\n"
+            "COPY catapp /bin/catapp\n"
+            'ENTRYPOINT ["/bin/catapp"]\n'
+        )
+
+        reg = FakeRegistry()
+        d = Daemon()
+        try:
+            d.kuke("build", str(ctx), "-t", "tool:v1")
+            dest = f"{reg.host}/team/tool:v1"
+            p = d.kuke("image", "push", "tool:v1", "--to", dest)
+            assert dest in p.stdout
+            d.kuke("image", "delete", "tool:v1")
+
+            d.kuke("image", "pull", dest)
+            manifest = f"""
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {{name: rt}}
+spec:
+  containers:
+    - name: main
+      image: "{dest}"
+      restartPolicy: {{policy: never}}
+"""
+            d.kuke("apply", "-f", "-", stdin_data=manifest)
+            deadline = _t.monotonic() + 15
+            log = ""
+            while _t.monotonic() < deadline:
+                log = d.kuke("log", "rt", check=False).stdout
+                if "pushed-bytes" in log:
+                    break
+                _t.sleep(0.5)
+            assert "pushed-bytes" in log, f"cell log: {log!r}"
+        finally:
+            d.stop()
+            reg.close()
+
+
 class TestLayerSafety:
     def test_escaping_whiteout_rejected(self, tmp_path):
         """A hostile layer naming ../../<host>/.wh.x must fail the pull,
@@ -510,3 +637,126 @@ class TestGlobalArgsAcrossStages:
         m = b.build(str(kf), str(ctx), "multiarg:1")
         assert m.env.get("BASE") == "yes"   # second FROM resolved base:v1
         assert os.path.exists(os.path.join(store.rootfs(m.ref), "out"))
+
+
+class TestPush:
+    """`kuke image push`: local bundle -> OCI blobs + manifest (VERDICT r4
+    item 6; reference: kukebuild pushes what it builds)."""
+
+    @staticmethod
+    def _local_image(tmp_path, name="myapp", tag="v1"):
+        from kukeon_tpu.runtime.images import ImageManifest
+
+        store = ImageStore(str(tmp_path / "src-store"))
+        m = ImageManifest(
+            name=name, tag=tag,
+            entrypoint=["/bin/app"], cmd=["--serve"],
+            env={"MODE": "prod"}, workdir="/srv",
+            labels={"team": "kukeon"},
+        )
+        store.put(m)
+        rootfs = store.rootfs(m.ref)
+        os.makedirs(os.path.join(rootfs, "srv"), exist_ok=True)
+        with open(os.path.join(rootfs, "srv", "data.txt"), "w") as f:
+            f.write("payload")
+        os.makedirs(os.path.join(rootfs, "bin"), exist_ok=True)
+        with open(os.path.join(rootfs, "bin", "app"), "w") as f:
+            f.write("#!/bin/sh\necho hi\n")
+        return store, m
+
+    def test_push_pull_roundtrip(self, tmp_path):
+        store, m = self._local_image(tmp_path)
+        reg = FakeRegistry()
+        try:
+            pushed = registry.push(store, m.ref,
+                                   dest=f"{reg.host}/team/myapp:v1")
+            assert pushed == f"{reg.host}/team/myapp:v1"
+
+            back = ImageStore(str(tmp_path / "dst-store"))
+            got = registry.pull(back, pushed)
+            assert got.entrypoint == ["/bin/app"]
+            assert got.cmd == ["--serve"]
+            assert got.env.get("MODE") == "prod"
+            assert got.workdir == "/srv"
+            assert got.labels.get("team") == "kukeon"
+            data = os.path.join(back.rootfs(got.ref), "srv", "data.txt")
+            with open(data) as f:
+                assert f.read() == "payload"
+        finally:
+            reg.close()
+
+    def test_second_push_dedups_blobs(self, tmp_path):
+        store, m = self._local_image(tmp_path)
+        reg = FakeRegistry()
+        try:
+            registry.push(store, m.ref, dest=f"{reg.host}/team/myapp:v1")
+            puts_first = len(reg.upload_auth_seen)
+            assert puts_first == 3  # config blob + layer blob + manifest
+            registry.push(store, m.ref, dest=f"{reg.host}/team/myapp:v1")
+            # Identical content: HEAD-dedup skips both blobs; only the
+            # manifest is re-PUT.
+            assert len(reg.upload_auth_seen) == puts_first + 1
+        finally:
+            reg.close()
+
+    def test_cross_origin_upload_redirect_strips_auth(self, tmp_path,
+                                                      monkeypatch):
+        """A registry that redirects blob uploads to object storage must not
+        receive our registry credentials at the third-party host (ADVICE r4:
+        docker-style clients strip auth on cross-host redirects)."""
+        storage = FakeRegistry()
+        primary = FakeRegistry(
+            upload_redirect_base=f"http://{storage.host}"
+        )
+        monkeypatch.setenv("KUKE_REGISTRY_USER", "kuke")
+        monkeypatch.setenv("KUKE_REGISTRY_PASSWORD", "sekrit")
+        store, m = self._local_image(tmp_path)
+        try:
+            registry.push(store, m.ref, dest=f"{primary.host}/team/myapp:v1")
+            # Blob PUTs landed on the storage host WITHOUT Authorization...
+            assert storage.upload_auth_seen, "uploads never hit storage host"
+            assert all(a is None for a in storage.upload_auth_seen)
+            # ...while the manifest PUT to the registry itself carried it.
+            assert primary.upload_auth_seen
+            assert all(a and a.startswith("Basic ")
+                       for a in primary.upload_auth_seen)
+        finally:
+            primary.close()
+            storage.close()
+
+
+class TestOpaqueWhiteoutSameLayer:
+    def test_opaque_dir_repopulated_in_same_layer(self, tmp_path):
+        """A layer that marks a directory opaque AND adds files under it in
+        the SAME layer: lower content drops, same-layer adds survive
+        (VERDICT r4 weak 8 — ordering was untested)."""
+        import io as _io
+        import tarfile as _tarfile
+
+        lower = _tar_layer({"app/old.txt": b"stale", "app/keepname": b"old"})
+
+        buf = _io.BytesIO()
+        with _tarfile.open(fileobj=buf, mode="w") as tf:
+            for name, content in (
+                ("app/.wh..wh..opq", b""),
+                ("app/new.txt", b"fresh"),
+                ("app/keepname", b"replaced"),
+            ):
+                info = _tarfile.TarInfo(name)
+                info.size = len(content)
+                tf.addfile(info, _io.BytesIO(content))
+        upper = gzip.compress(buf.getvalue())
+
+        reg = FakeRegistry()
+        try:
+            reg.add_image("lib/img", "v1", [lower, upper], CONFIG)
+            store = ImageStore(str(tmp_path / "store"))
+            m = registry.pull(store, f"{reg.host}/lib/img:v1")
+            rootfs = store.rootfs(m.ref)
+            assert not os.path.exists(os.path.join(rootfs, "app", "old.txt"))
+            with open(os.path.join(rootfs, "app", "new.txt")) as f:
+                assert f.read() == "fresh"
+            with open(os.path.join(rootfs, "app", "keepname")) as f:
+                assert f.read() == "replaced"
+        finally:
+            reg.close()

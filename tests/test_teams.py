@@ -454,6 +454,37 @@ class TestTeamInit:
         assert py.parent == "kukeon.internal/claude-basic:v1"
         assert py.env["LAYER"] == "py"
 
+    def test_build_push_targets_config_registry(self, tmp_path, team_host):
+        """--build --push: every built image is pushed to the TeamsConfig
+        registry (reference: teambuild's REGISTRY threading + kukebuild
+        push auth, internal/teambuild/teambuild.go:17-42)."""
+        from kukeon_tpu.runtime.images import ImageBuilder, ImageStore
+
+        project_file = tmp_path / "team.yaml"
+        project_file.write_text(PROJECT_YAML)
+        store = ImageStore(str(tmp_path / "rp"))
+        pushed = []
+
+        def pusher(tag, reg):
+            pushed.append((tag, reg))
+            return f"{reg}/{tag}"
+
+        res = team_init(None, str(project_file), host=team_host,
+                        dry_run=True, build=True,
+                        builder=ImageBuilder(store), pusher=pusher)
+        assert [r for _, r in pushed] == ["reg.example.com"] * 2
+        assert res.pushed_images == [
+            "reg.example.com/kukeon.internal/claude-basic:v1",
+            "reg.example.com/kukeon.internal/claude-py:v1",
+        ]
+
+    def test_push_without_build_rejected(self, tmp_path, team_host):
+        project_file = tmp_path / "team.yaml"
+        project_file.write_text(PROJECT_YAML)
+        with pytest.raises(InvalidArgument, match="--push requires --build"):
+            team_init(None, str(project_file), host=team_host,
+                      dry_run=True, pusher=lambda t, r: t)
+
     def test_dry_run_touches_nothing(self, tmp_path, team_host):
         project_file = tmp_path / "team.yaml"
         project_file.write_text(PROJECT_YAML)
