@@ -419,8 +419,14 @@ def forward(
                 cv = _cache_insert(cv, qv, offsets)
                 cks = _cache_insert(cks, sk, offsets)
                 cvs = _cache_insert(cvs, sv, offsets)
-                ak = ck.astype(c.dtype) * cks[..., None].astype(c.dtype)
-                av = cv.astype(c.dtype) * cvs[..., None].astype(c.dtype)
+                # Dequantize in f32 and cast the PRODUCT down: scaling the
+                # f32 scales to bf16 first would double-round, and the fused
+                # decode path applies scales in f32 — the two paths must
+                # agree numerically (ADVICE r4).
+                ak = (ck.astype(jnp.float32)
+                      * cks[..., None].astype(jnp.float32)).astype(c.dtype)
+                av = (cv.astype(jnp.float32)
+                      * cvs[..., None].astype(jnp.float32)).astype(c.dtype)
             else:
                 ck = _cache_insert(ck, k, offsets)
                 cv = _cache_insert(cv, v, offsets)

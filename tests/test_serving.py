@@ -125,6 +125,19 @@ def test_v5e8_mesh_serving_at_8b_kv_divisibility():
         eng8.step()
     assert all(len(r.generated) == 12 for r in reqs)
 
+    # int8 KV cache on the SAME kv-head-sharded layout (ADVICE r4: the
+    # quantized-cache scale sharding was only single-device-tested): the
+    # fused-dequant decode must agree with the single-device int8-KV engine.
+    eng8q = ServingEngine(cfg, qp, mesh8, num_slots=4, max_seq_len=128,
+                          kv_cache_int8=True)
+    assert eng8q.state.cache.quantized
+    got8q = eng8q.generate(prompt, sp)
+    eng1q = ServingEngine(cfg, qp, mesh1, num_slots=4, max_seq_len=128,
+                          kv_cache_int8=True)
+    got1q = eng1q.generate(prompt, sp)
+    assert len(got8q) == 12
+    assert got8q == got1q, f"8-dev int8-KV diverged: {got8q} vs {got1q}"
+
 
 def test_int8_kv_cache_engine_parity():
     """An int8-KV engine must complete continuous-batching generation and
