@@ -437,6 +437,42 @@ def main() -> None:
     if cold_errors:
         cold["error"] = "; ".join(cold_errors)[-500:]
     result["cold_start"] = cold
+
+    # TPU measurement history (committed): a genuine TPU number must survive
+    # a later flaky-tunnel run. On a TPU measurement, append it; on a
+    # CPU-degraded run, reference the last recorded TPU result so the
+    # artifact names what the hardware did when it was reachable.
+    history = os.path.join(REPO, "BENCH_TPU_HISTORY.jsonl")
+    if serve["backend"] == "tpu":
+        try:
+            entry = {
+                "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "backend": "tpu", "n_chips": serve["n_chips"],
+                "model": serve["model"], "sessions": serve["sessions"],
+                "tok_per_s": round(serve["tok_per_s"], 2),
+                "trials": serve["trials"],
+                "vs_baseline": result["vs_baseline"],
+                "cold_start": cold,
+            }
+            with open(history, "a") as f:
+                f.write(json.dumps(entry) + "\n")
+        except OSError:
+            pass
+    else:
+        try:
+            with open(history) as f:
+                lines = [ln for ln in f.read().splitlines() if ln.strip()]
+            if lines:
+                last = json.loads(lines[-1])
+                result["last_tpu"] = {
+                    "at": last.get("at"),
+                    "tok_per_s": last.get("tok_per_s"),
+                    "vs_baseline": last.get("vs_baseline"),
+                    "cold_start_p50_s": (last.get("cold_start") or {}).get("p50_s"),
+                    "note": "most recent real-TPU measurement (this run degraded to CPU)",
+                }
+        except (OSError, ValueError):
+            pass
     print(json.dumps(result))
 
 
