@@ -68,28 +68,52 @@ def _quant_scale_spec(spec: P, q, s) -> P:
     return P(spec[0] if s.shape[0] == q.shape[0] else spec[1])
 
 
-def shard_params(params, mesh: Mesh, fsdp: bool = False):
+def param_shardings(params, mesh: Mesh, fsdp: bool = False):
+    """NamedSharding pytree matching ``params``' structure (quantized
+    {"q","s"} leaves expanded), without touching any device."""
+    specs = specs_for_params(params, fsdp)
+
+    def expand(spec, leaf):
+        if isinstance(leaf, dict) and "q" in leaf:
+            return {
+                "q": NamedSharding(mesh, spec),
+                "s": NamedSharding(
+                    mesh, _quant_scale_spec(spec, leaf["q"], leaf["s"])
+                ),
+            }
+        return NamedSharding(mesh, spec)
+
+    return jax.tree.map(
+        expand, specs, params,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params, mesh: Mesh, fsdp: bool = False, threads: int = 4):
     """Device-put a param pytree with the canonical shardings.
 
     Quantized leaves ({"q": int8 matrix, "s": scale}) inherit the matrix
-    spec for q; the scale shards with the matrix's surviving axes."""
-    specs = specs_for_params(params, fsdp)
+    spec for q; the scale shards with the matrix's surviving axes.
 
-    def put(spec, leaf):
-        if isinstance(leaf, dict) and "q" in leaf:
-            return {
-                "q": jax.device_put(leaf["q"], NamedSharding(mesh, spec)),
-                "s": jax.device_put(
-                    leaf["s"],
-                    NamedSharding(mesh, _quant_scale_spec(spec, leaf["q"], leaf["s"])),
-                ),
-            }
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    Transfers are issued from a small thread pool: on a direct PCIe link
+    this changes nothing measurable, but on a tunneled/remote chip the
+    per-transfer RPC latency dominates and concurrent streams pipeline it
+    (an 8B int8 tree is ~300 leaves; serial puts pay ~300 round trips)."""
+    shardings = param_shardings(params, mesh, fsdp)
+    flat_s, treedef = jax.tree.flatten(shardings)
+    flat_p, _ = jax.tree.flatten(params)
 
-    return jax.tree.map(
-        put, specs, params,
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    if threads <= 1 or len(flat_p) < 8:
+        out = [jax.device_put(x, s) for x, s in zip(flat_p, flat_s)]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            out = list(pool.map(
+                lambda xs: jax.device_put(xs[0], xs[1]),
+                zip(flat_p, flat_s),
+            ))
+    return jax.tree.unflatten(treedef, out)
 
 
 def bert_param_specs(fsdp: bool = False) -> dict:

@@ -129,6 +129,7 @@ class ServingEngine:
         seed: int = 0,
         int8_pallas: bool | None = None,
         kv_cache_int8: bool = False,
+        async_load: bool = False,
     ):
         # int8_pallas=None -> auto: route quantized decode matmuls through
         # the Pallas kernel on a single-chip TPU mesh when the operator opts
@@ -174,9 +175,38 @@ class ServingEngine:
 
         if mesh is None:
             raise ValueError("ServingEngine requires a mesh (use make_mesh(tensor=1) for one device)")
-        self.params = shd.shard_params(params, mesh)
-        with jax.set_mesh(mesh):
-            self.state = self._init_state()
+        # Abstract (shape+sharding) view of the params, available before any
+        # byte reaches the device — what precompile() lowers against.
+        self._shardings = shd.param_shardings(params, mesh)
+        self._abstract_params = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            params, self._shardings,
+        )
+        self._load_exc: Exception | None = None
+        self._loaded = threading.Event()
+        if async_load:
+            # Weight transfer off-thread so cold start can overlap it with
+            # precompile(): the boot pays max(transfer, compile), not the
+            # sum. On a tunneled chip both are minutes; this matters.
+            self.params = None
+
+            def _load():
+                try:
+                    self.params = shd.shard_params(params, mesh)
+                    with jax.set_mesh(mesh):
+                        self.state = self._init_state()
+                except Exception as e:  # noqa: BLE001 — surfaced by _ensure_loaded
+                    self._load_exc = e
+                finally:
+                    self._loaded.set()
+
+            threading.Thread(target=_load, daemon=True,
+                             name="engine-weight-load").start()
+        else:
+            self.params = shd.shard_params(params, mesh)
+            with jax.set_mesh(mesh):
+                self.state = self._init_state()
+            self._loaded.set()
 
         self._requests: dict[int, Request] = {}
         self._slot_req: list[Request | None] = [None] * num_slots
@@ -196,20 +226,24 @@ class ServingEngine:
 
     # --- jitted programs ---------------------------------------------------
 
-    def _init_state(self) -> DecodeState:
-        cache = llama.KVCache.create(
-            self.cfg, self.num_slots, self.max_seq_len,
-            quantized=self.kv_cache_int8,
-        )
+    def _cache_shardings(self) -> tuple[NamedSharding, NamedSharding]:
+        """(k/v sharding, scale sharding) for the decode cache."""
         spec = shd.kv_cache_spec()
         tensor_size = self.mesh.shape.get(shd.AXIS_TENSOR, 1)
         if self.cfg.num_kv_heads % max(tensor_size, 1):
             # KV heads not divisible by the tensor axis: replicate the cache
             # (correct, just more HBM) instead of failing device_put.
             spec = PartitionSpec()
-        kv_sharding = NamedSharding(self.mesh, spec)
         # Scales [L, B, S, KV] shard like k/v minus the head_dim axis.
-        sc_sharding = NamedSharding(self.mesh, PartitionSpec(*spec[:4]))
+        return (NamedSharding(self.mesh, spec),
+                NamedSharding(self.mesh, PartitionSpec(*spec[:4])))
+
+    def _init_state(self) -> DecodeState:
+        cache = llama.KVCache.create(
+            self.cfg, self.num_slots, self.max_seq_len,
+            quantized=self.kv_cache_int8,
+        )
+        kv_sharding, sc_sharding = self._cache_shardings()
         cache = llama.KVCache(
             k=jax.device_put(cache.k, kv_sharding),
             v=jax.device_put(cache.v, kv_sharding),
@@ -304,6 +338,85 @@ class ServingEngine:
             decode_chunk_fn, static_argnums=(6,), donate_argnums=(1,)
         )
 
+    def _ensure_loaded(self):
+        """Block until the (possibly async) weight transfer finished."""
+        if not self._loaded.is_set():
+            self._loaded.wait()
+        if self._load_exc is not None:
+            raise RuntimeError("engine weight load failed") from self._load_exc
+
+    def _abstract_state(self) -> DecodeState:
+        """ShapeDtypeStruct mirror of _init_state (no device bytes)."""
+        shapes = jax.eval_shape(
+            lambda: llama.KVCache.create(
+                self.cfg, self.num_slots, self.max_seq_len,
+                quantized=self.kv_cache_int8,
+            )
+        )
+        kv_sh, sc_sh = self._cache_shardings()
+        repl = NamedSharding(self.mesh, PartitionSpec())
+
+        def sds(x, sh):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+
+        cache = llama.KVCache(
+            k=sds(shapes.k, kv_sh), v=sds(shapes.v, kv_sh),
+            lengths=sds(shapes.lengths, repl),
+            k_scale=(sds(shapes.k_scale, sc_sh)
+                     if shapes.k_scale is not None else None),
+            v_scale=(sds(shapes.v_scale, sc_sh)
+                     if shapes.v_scale is not None else None),
+        )
+        B = self.num_slots
+        return DecodeState(
+            cache=cache,
+            tokens=jax.ShapeDtypeStruct((B,), jnp.int32, sharding=repl),
+            active=jax.ShapeDtypeStruct((B,), jnp.bool_, sharding=repl),
+        )
+
+    def precompile(self, prompt_lens: tuple[int, ...] = (64,)):
+        """AOT-compile the engine's programs from shapes alone — no weights
+        needed, so with ``async_load`` this runs WHILE the multi-GB param
+        transfer streams in the background and the cold boot pays
+        max(transfer, compile) instead of their sum. The compiled
+        executables land in the persistent compilation cache; the first
+        real dispatch is then a cache hit, not a compile.
+        """
+        aparams = self._abstract_params
+        astate = self._abstract_state()
+        cfg = self.cfg
+        B = self.num_slots
+        key = jax.random.key(0)
+        temps = jnp.zeros((B,), jnp.float32)
+        top_ks = jnp.zeros((B,), jnp.int32)
+        top_ps = jnp.ones((B,), jnp.float32)
+
+        with jax.set_mesh(self.mesh):
+            buckets = sorted({
+                min(bucket_length(max(1, n)), self.max_seq_len)
+                for n in prompt_lens
+            })
+            for L in buckets:
+                tokens = jax.ShapeDtypeStruct((1, L), jnp.int32)
+                self._prefill.lower(
+                    aparams, tokens, L // 2, key,
+                    jnp.float32(0.0), jnp.int32(0), jnp.float32(1.0),
+                ).compile()
+                kv_shape = (cfg.num_layers, 1, L, cfg.num_kv_heads, cfg.head_dim)
+                kv = jax.ShapeDtypeStruct(kv_shape, cfg.dtype)
+                self._insert.lower(
+                    astate, kv, kv, L // 2, 0, jnp.int32(1),
+                ).compile()
+            chunk_sizes = {1, 4}
+            size = 1
+            while size * 4 <= self.decode_chunk:
+                size *= 4
+                chunk_sizes.add(size)
+            for k in sorted(chunk_sizes):
+                self._decode_chunk.lower(
+                    aparams, astate, key, temps, top_ks, top_ps, k,
+                ).compile()
+
     # --- public API --------------------------------------------------------
 
     def submit(
@@ -351,6 +464,7 @@ class ServingEngine:
         chunk programs can be compiled against the live state. Sampling
         parameters are dynamic, so one warmup covers all request mixes.
         """
+        self._ensure_loaded()
         sp = sampling or SamplingParams()
         req = self.submit(
             np.ones((max(1, prompt_len),), np.int32),
@@ -478,6 +592,7 @@ class ServingEngine:
 
         Returns True if any work was done.
         """
+        self._ensure_loaded()
         did_work = self._sweep_cancelled()
         prefills = []
         for slot in self._free_slots():

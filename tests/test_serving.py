@@ -167,3 +167,55 @@ def test_int8_kv_cache_engine_parity():
     while not all(r.done.is_set() for r in reqs):
         eng_q.step()
     assert [r.generated for r in reqs] == got_q
+
+
+def test_async_load_engine_parity():
+    """async_load=True (weight transfer off-thread, the cold-start overlap
+    path) must produce identical generations to the synchronous engine."""
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+    prompt = np.arange(3, 35, dtype=np.int32) % cfg.vocab_size
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+
+    eng_sync = ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=128)
+    want = eng_sync.generate(prompt, sp)
+
+    eng_async = ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=128,
+                              async_load=True)
+    got = eng_async.generate(prompt, sp)   # step() blocks on the load
+    assert got == want
+
+
+def test_precompile_runs_before_weights_arrive():
+    """precompile() needs shapes only: it must complete against an engine
+    whose weight transfer hasn't been waited on, and the subsequent warmup
+    + generate must work unchanged (the ServingCell cold-start sequence)."""
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(1), cfg)
+    mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+    eng = ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=128,
+                        async_load=True)
+    eng.precompile((64,))
+    eng.warmup(64)
+    toks = eng.generate(np.arange(1, 20, dtype=np.int32) % cfg.vocab_size,
+                        SamplingParams(temperature=0.0, max_new_tokens=4))
+    assert len(toks) == 4
+
+
+def test_async_load_failure_surfaces(monkeypatch):
+    """A failed weight transfer must raise from step(), not hang waiters."""
+    from kukeon_tpu.parallel import sharding as shd
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(2), cfg)
+    mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+
+    def boom(*a, **kw):
+        raise OSError("device lost mid-transfer")
+
+    monkeypatch.setattr(shd, "shard_params", boom)
+    eng = ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=128,
+                        async_load=True)
+    with pytest.raises(RuntimeError, match="weight load failed"):
+        eng.generate(np.ones((4,), np.int32), SamplingParams(max_new_tokens=2))
