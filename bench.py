@@ -184,6 +184,10 @@ def phase_serve(args) -> None:
     sp = SamplingParams(max_new_tokens=new_tokens)
 
     engine.warmup(prompt_len, sp)
+    # Warmup's single pass overlaps the tail of the async param transfer;
+    # measuring before every byte lands would charge transfer time to
+    # trial 1 (r5: first trial measured 2 tok/s vs 261 steady-state).
+    jax.block_until_ready(engine.params)
     _log("warmup done; measuring...")
 
     # The chip link can jitter; median of several trials.
