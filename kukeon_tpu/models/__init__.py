@@ -7,4 +7,4 @@ from kukeon_tpu.models.llama import (  # noqa: F401
     llama3_8b,
     llama_tiny,
 )
-from kukeon_tpu.models import bert  # noqa: F401
+from kukeon_tpu.models import bert, moe  # noqa: F401

@@ -1,0 +1,278 @@
+"""Mixtral-style sparse Mixture-of-Experts decoder, functional JAX.
+
+Second model family of the in-tree serving/training path (the reference
+runtime has no model math — SURVEY.md §2.9; this widens the TPU build's
+model zoo alongside :mod:`kukeon_tpu.models.llama` and gives the ``expert``
+mesh axis a real workload).
+
+TPU-first design:
+
+- **Same attention trunk as Llama** (GQA + RoPE + RMSNorm, stacked layers
+  under ``lax.scan``, the shared KVCache layout) — the MoE block replaces
+  only the dense SwiGLU MLP, exactly like Mixtral-vs-Mistral.
+- **Dense-dispatch MoE (GShard/Switch formulation)**: routing is expressed
+  as two einsums against a static-capacity one-hot dispatch tensor instead
+  of gather/scatter with dynamic shapes. Everything is a fixed-shape batched
+  matmul over a leading ``E`` axis — MXU-friendly, one compiled program —
+  and sharding ``E`` over the mesh's ``expert`` axis makes GSPMD insert the
+  dispatch/combine all-to-alls over ICI.
+- **Static capacity**: each expert processes at most
+  ``capacity_factor * tokens * top_k / num_experts`` tokens; overflow tokens
+  fall through the residual (standard GShard semantics). Tests use a
+  capacity factor that guarantees no drops when checking numerics.
+- **Aux losses for training**: Switch load-balance loss + router z-loss,
+  returned by :func:`forward_with_aux`; :func:`forward` keeps the exact
+  serving signature of ``llama.forward`` (logits, cache).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kukeon_tpu.models import llama
+from kukeon_tpu.models.llama import KVCache, _cache_insert, _embed, _mm
+from kukeon_tpu.ops.attention import gqa_attention
+from kukeon_tpu.ops.norms import rms_norm
+from kukeon_tpu.ops.rope import apply_rope
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    num_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 2.0
+    rope_theta: float = 1_000_000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    router_z_coef: float = 1e-3
+    load_balance_coef: float = 1e-2
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+
+def mixtral_8x7b() -> MoEConfig:
+    """Mixtral-8x7B shapes (public architecture)."""
+    return MoEConfig()
+
+
+def moe_tiny() -> MoEConfig:
+    """Test-size config: fast on a CPU mesh; 4 experts so expert=2 shards."""
+    return MoEConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        num_experts=4, experts_per_token=2, capacity_factor=8.0,
+        rope_theta=10_000.0, max_seq_len=256, dtype=jnp.float32,
+        tie_embeddings=True,
+    )
+
+
+def init_params(key: jax.Array, cfg: MoEConfig) -> Params:
+    """Random-init. Layout (stacked layers axis 0, experts axis 1):
+
+      embed:   [V, H]
+      layers:  attn_norm/mlp_norm [L, H], wq [L, H, NH*D], wk/wv [L, H, KV*D],
+               wo [L, NH*D, H], router [L, H, E],
+               w_gate/w_up [L, E, H, I], w_down [L, E, I, H]
+      final_norm: [H];  lm_head: [H, V] (absent when tie_embeddings)
+    """
+    c = cfg
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(k, shape, fan_in):
+        scale = fan_in ** -0.5
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(c.dtype)
+
+    L, H, I, V, E = (c.num_layers, c.hidden_size, c.intermediate_size,
+                     c.vocab_size, c.num_experts)
+    params: Params = {
+        "embed": dense(next(keys), (V, H), H),
+        "layers": {
+            "attn_norm": jnp.ones((L, H), c.dtype),
+            "wq": dense(next(keys), (L, H, c.q_dim), H),
+            "wk": dense(next(keys), (L, H, c.kv_dim), H),
+            "wv": dense(next(keys), (L, H, c.kv_dim), H),
+            "wo": dense(next(keys), (L, c.q_dim, H), c.q_dim),
+            "mlp_norm": jnp.ones((L, H), c.dtype),
+            # Router in f32: tiny, and routing decisions should not wobble
+            # with the activation dtype.
+            "router": jax.random.normal(next(keys), (L, H, E), jnp.float32) * (H ** -0.5),
+            "w_gate": dense(next(keys), (L, E, H, I), H),
+            "w_up": dense(next(keys), (L, E, H, I), H),
+            "w_down": dense(next(keys), (L, E, I, H), I),
+        },
+        "final_norm": jnp.ones((H,), c.dtype),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = dense(next(keys), (H, V), H)
+    return params
+
+
+def _capacity(cfg: MoEConfig, n_tokens: int) -> int:
+    cap = int(cfg.capacity_factor * n_tokens * cfg.experts_per_token
+              / cfg.num_experts)
+    return max(cap, cfg.experts_per_token)
+
+
+def moe_block(h: jnp.ndarray, w: dict, cfg: MoEConfig) -> tuple[jnp.ndarray, dict]:
+    """Sparse-MoE SwiGLU over [B, S, H] -> ([B, S, H], aux losses).
+
+    GShard dense-dispatch: top-k routing -> static-capacity one-hot dispatch
+    tensor -> two einsums around batched per-expert matmuls. All shapes are
+    static; with ``w_gate``'s E axis sharded on the mesh's ``expert`` axis,
+    XLA partitions the expert matmuls per chip and inserts all-to-alls for
+    the dispatch/combine einsums.
+    """
+    c = cfg
+    B, S, H = h.shape
+    N = B * S
+    E, K = c.num_experts, c.experts_per_token
+    C = _capacity(c, N)
+    x = h.reshape(N, H)
+
+    router_logits = x.astype(jnp.float32) @ w["router"]          # [N, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, K)              # [N, K]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+
+    # Priority dispatch: choice slot 0 of every token beats slot 1 (GShard).
+    # mask: [K, N, E]; position_in_expert via a cumulative count over the
+    # flattened (K, N) order.
+    mask = jax.nn.one_hot(expert_idx.T, E, dtype=jnp.float32)    # [K, N, E]
+    flat = mask.reshape(K * N, E)
+    pos = jnp.cumsum(flat, axis=0) - flat                        # tokens ahead
+    keep = (pos < C).astype(jnp.float32) * flat                  # drop overflow
+    # dispatch [N, E, C]: one-hot of each kept (token, choice) -> its slot.
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
+    dispatch = (keep[..., None] * slot).reshape(K, N, E, C).sum(axis=0)
+    combine = dispatch * (
+        (mask * gate_vals.T[..., None]).sum(axis=0)[..., None]   # [N, E, 1]
+    )
+
+    # Dispatch -> per-expert batches -> SwiGLU -> combine.
+    xe = jnp.einsum("nec,nh->ech", dispatch, x).astype(c.dtype)  # [E, C, H]
+    gate = jax.nn.silu(
+        jnp.einsum("ech,ehi->eci", xe, w["w_gate"]).astype(jnp.float32)
+    ).astype(c.dtype)
+    up = jnp.einsum("ech,ehi->eci", xe, w["w_up"])
+    ye = jnp.einsum("eci,eih->ech", gate * up, w["w_down"])      # [E, C, H]
+    y = jnp.einsum("nec,ech->nh", combine.astype(c.dtype), ye)
+
+    # Aux losses (f32): Switch load-balance (E * sum_e f_e * P_e; 1.0 at
+    # perfect balance) over FIRST-choice assignments, + router z-loss.
+    f = jnp.mean(mask[0], axis=0)                                # [E]
+    p = jnp.mean(probs, axis=0)                                  # [E]
+    lb = E * jnp.sum(f * p)
+    z = jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2)
+    return y.reshape(B, S, H), {"load_balance": lb, "router_z": z}
+
+
+def forward_with_aux(
+    params: Params,
+    cfg: MoEConfig,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    cache: KVCache | None = None,
+    attn_impl: str = "auto",
+) -> tuple[jnp.ndarray, KVCache | None, dict]:
+    """Run the MoE decoder; returns (logits, cache', aux-loss dict).
+
+    Cache semantics identical to ``llama.forward`` (same KVCache layout, so
+    the serving engine's insert/decode programs carry over unchanged)."""
+    c = cfg
+    B, S = tokens.shape
+    x = _embed(params, tokens, c.dtype)
+    offsets = cache.lengths if cache is not None else None
+
+    def layer_step(carry, layer):
+        x, lb_sum, z_sum = carry
+        w, layer_cache = layer
+        h = rms_norm(x, w["attn_norm"], c.rms_norm_eps)
+        q = _mm(h, w["wq"]).reshape(B, S, c.num_heads, c.head_dim)
+        k = _mm(h, w["wk"]).reshape(B, S, c.num_kv_heads, c.head_dim)
+        v = _mm(h, w["wv"]).reshape(B, S, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, positions, c.rope_theta)
+        k = apply_rope(k, positions, c.rope_theta)
+
+        if layer_cache is not None:
+            ck, cv = layer_cache
+            ck = _cache_insert(ck, k, offsets)
+            cv = _cache_insert(cv, v, offsets)
+            kv_positions = jnp.broadcast_to(
+                jnp.arange(ck.shape[1], dtype=jnp.int32)[None, :], (B, ck.shape[1])
+            )
+            attn = gqa_attention(
+                q, ck, cv,
+                q_positions=positions, kv_positions=kv_positions,
+                kv_length=offsets + S, impl=attn_impl,
+            )
+            new_layer_cache = (ck, cv)
+        else:
+            attn = gqa_attention(
+                q, k, v,
+                q_positions=positions, kv_positions=positions, impl=attn_impl,
+            )
+            new_layer_cache = None
+
+        x = x + _mm(attn.reshape(B, S, c.q_dim), w["wo"])
+
+        h = rms_norm(x, w["mlp_norm"], c.rms_norm_eps)
+        y, aux = moe_block(h, w, c)
+        x = x + y
+        return (x, lb_sum + aux["load_balance"], z_sum + aux["router_z"]), new_layer_cache
+
+    layer_ws = params["layers"]
+    init = (x, jnp.float32(0.0), jnp.float32(0.0))
+    if cache is not None:
+        (x, lb, z), (new_k, new_v) = jax.lax.scan(
+            lambda carry, layer: layer_step(carry, (layer[0], (layer[1], layer[2]))),
+            init, (layer_ws, cache.k, cache.v),
+        )
+        new_cache = KVCache(k=new_k, v=new_v, lengths=cache.lengths + S)
+    else:
+        (x, lb, z), _ = jax.lax.scan(
+            lambda carry, w: layer_step(carry, (w, None)), init, layer_ws
+        )
+        new_cache = None
+
+    x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    logits = llama._logits(params, c, x)
+    aux = {"load_balance": lb / c.num_layers, "router_z": z / c.num_layers}
+    return logits, new_cache, aux
+
+
+def forward(
+    params: Params,
+    cfg: MoEConfig,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    cache: KVCache | None = None,
+    attn_impl: str = "auto",
+) -> tuple[jnp.ndarray, KVCache | None]:
+    """Serving-signature forward (drop-in for ``llama.forward``)."""
+    logits, new_cache, _ = forward_with_aux(
+        params, cfg, tokens, positions, cache, attn_impl
+    )
+    return logits, new_cache

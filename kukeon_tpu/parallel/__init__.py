@@ -1,5 +1,6 @@
 from kukeon_tpu.parallel.mesh import (  # noqa: F401
     AXIS_DATA,
+    AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_SEQ,
     AXIS_TENSOR,
@@ -13,6 +14,8 @@ from kukeon_tpu.parallel.sharding import (  # noqa: F401
     batch_spec,
     kv_cache_spec,
     llama_param_specs,
+    moe_param_specs,
+    moe_specs_for_params,
     shard_params,
     specs_for_params,
 )

@@ -7,10 +7,14 @@ Canonical axis names for the whole framework (the scaling-book convention):
                  (params all-gathered per layer, grads reduce-scattered).
   - ``tensor``:  tensor (megatron-style) parallelism inside a layer.
   - ``seq``:     sequence/context parallelism (ring attention).
+  - ``expert``:  expert parallelism (MoE: experts sharded over chips, token
+                 dispatch/combine become all-to-alls inserted by GSPMD from
+                 the einsum shardings — models/moe.py).
 
-Serving uses (data, tensor); training adds fsdp/seq. On a TPU slice the mesh
-should be laid out so that ``tensor`` (highest-bandwidth collectives) maps to
-the innermost ICI dimension — ``jax.make_mesh`` handles device ordering.
+Serving uses (data, tensor); training adds fsdp/seq; MoE models add expert.
+On a TPU slice the mesh should be laid out so that ``tensor`` (highest-
+bandwidth collectives) maps to the innermost ICI dimension —
+``jax.make_mesh`` handles device ordering.
 """
 
 from __future__ import annotations
@@ -24,6 +28,7 @@ AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_TENSOR = "tensor"
 AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
 
 
 def make_mesh(
@@ -31,24 +36,26 @@ def make_mesh(
     fsdp: int = 1,
     tensor: int = 1,
     seq: int = 1,
+    expert: int = 1,
     *,
     devices=None,
 ) -> Mesh:
     """Build a mesh with the canonical axes; sizes must multiply to #devices."""
     devices = devices if devices is not None else jax.devices()
-    want = data * fsdp * tensor * seq
+    want = data * fsdp * tensor * seq * expert
     if want != len(devices):
         raise ValueError(
-            f"mesh {data}x{fsdp}x{tensor}x{seq}={want} != {len(devices)} devices"
+            f"mesh {data}x{fsdp}x{expert}x{seq}x{tensor}={want} != "
+            f"{len(devices)} devices"
         )
     # Auto axis types: GSPMD propagates shardings from the annotations we set
     # at jit boundaries (jax 0.9 defaults to Explicit mode, which turns
     # with_sharding_constraint into an assert — not what this codebase wants).
     return jax.make_mesh(
-        (data, fsdp, seq, tensor),
-        (AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR),
+        (data, fsdp, expert, seq, tensor),
+        (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR),
         devices=devices,
-        axis_types=(jax.sharding.AxisType.Auto,) * 4,
+        axis_types=(jax.sharding.AxisType.Auto,) * 5,
     )
 
 

@@ -117,10 +117,16 @@ def ring_attention(
 
     qkv_spec = P(batch_axes, axis_name, head_axis, None)
     pos_spec = P(batch_axes, axis_name)
+    # Accumulators become varying ONLY over axes the inputs are sharded on;
+    # axes this op never touches (e.g. ``expert``) must stay invariant or
+    # shard_map's replication check rejects the out_specs.
+    used = {*(batch_axes or ()), axis_name}
+    if head_axis:
+        used.add(head_axis)
     fn = functools.partial(
         _ring_attention_local,
         axis_name=axis_name,
-        all_axes=tuple(mesh.axis_names),
+        all_axes=tuple(a for a in mesh.axis_names if a in used),
     )
     return jax.shard_map(
         fn,

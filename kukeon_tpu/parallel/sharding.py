@@ -22,7 +22,13 @@ from __future__ import annotations
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kukeon_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR
+from kukeon_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+)
 
 
 def llama_param_specs(fsdp: bool = False) -> dict:
@@ -114,6 +120,42 @@ def shard_params(params, mesh: Mesh, fsdp: bool = False, threads: int = 4):
                 zip(flat_p, flat_s),
             ))
     return jax.tree.unflatten(treedef, out)
+
+
+def moe_param_specs(fsdp: bool = False) -> dict:
+    """PartitionSpec pytree matching models.moe.init_params.
+
+    The attention trunk shards exactly like Llama; the expert weights put
+    their E axis on ``expert`` (each chip owns E/ep experts — GSPMD turns
+    the dispatch/combine einsums into all-to-alls) and keep the megatron
+    column->row pairing on ``tensor`` within each expert. The router is
+    tiny and replicated."""
+    f = AXIS_FSDP if fsdp else None
+    t = AXIS_TENSOR
+    e = AXIS_EXPERT
+    specs = {
+        "embed": P(t, f),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, f, t),
+            "wk": P(None, f, t),
+            "wv": P(None, f, t),
+            "wo": P(None, t, f),
+            "mlp_norm": P(None, None),
+            "router": P(None, None, None),
+            "w_gate": P(None, e, f, t),          # [L, E, H, I]
+            "w_up": P(None, e, f, t),
+            "w_down": P(None, e, t, f),          # [L, E, I, H]
+        },
+        "final_norm": P(None),
+    }
+    specs["lm_head"] = P(f, t)
+    return specs
+
+
+def moe_specs_for_params(params, fsdp: bool = False) -> dict:
+    full = moe_param_specs(fsdp)
+    return {k: full[k] for k in params}
 
 
 def bert_param_specs(fsdp: bool = False) -> dict:

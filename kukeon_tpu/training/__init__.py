@@ -1,5 +1,7 @@
 from kukeon_tpu.training.train_step import (  # noqa: F401
     TrainState,
+    create_moe_train_state,
     create_train_state,
+    make_moe_train_step,
     make_train_step,
 )

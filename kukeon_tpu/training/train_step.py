@@ -63,18 +63,25 @@ def create_train_state(
     mesh: Mesh,
     key: jax.Array,
     optimizer: optax.GradientTransformation | None = None,
+    *,
+    init_fn=None,
+    specs=None,
 ) -> tuple[TrainState, optax.GradientTransformation]:
-    """Init params + optimizer state directly with fsdp/tensor shardings."""
+    """Init params + optimizer state directly with fsdp/tensor shardings.
+
+    ``init_fn(key) -> params`` and ``specs`` (a PartitionSpec pytree)
+    override the Llama defaults — the MoE family passes its own
+    (moe.init_params, shd.moe_specs_for_params)."""
     optimizer = optimizer or make_optimizer()
+    init_fn = init_fn or (lambda k: llama.init_params(k, cfg))
     # Abstract-init to get the tree structure without materializing twice.
-    abstract = jax.eval_shape(lambda k: llama.init_params(k, cfg), key)
-    specs = shd.specs_for_params(abstract, fsdp=True)
+    abstract = jax.eval_shape(init_fn, key)
+    if specs is None:
+        specs = shd.specs_for_params(abstract, fsdp=True)
     shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
     )
-    params = jax.jit(
-        lambda k: llama.init_params(k, cfg), out_shardings=shardings
-    )(key)
+    params = jax.jit(init_fn, out_shardings=shardings)(key)
     opt_state = jax.jit(
         optimizer.init,
         out_shardings=None,  # optax state mirrors param shardings via init tracing
@@ -123,6 +130,61 @@ def make_train_step(
         return (
             TrainState(params=new_params, opt_state=new_opt, step=state.step + 1),
             loss,
+        )
+
+    return train_step, batch_sharding
+
+
+def create_moe_train_state(cfg, mesh: Mesh, key: jax.Array,
+                           optimizer: optax.GradientTransformation | None = None):
+    """MoE variant of :func:`create_train_state` (expert-sharded weights)."""
+    from kukeon_tpu.models import moe
+
+    abstract = jax.eval_shape(lambda k: moe.init_params(k, cfg), key)
+    return create_train_state(
+        cfg, mesh, key, optimizer,
+        init_fn=lambda k: moe.init_params(k, cfg),
+        specs=shd.moe_specs_for_params(abstract, fsdp=True),
+    )
+
+
+def make_moe_train_step(cfg, mesh: Mesh, optimizer: optax.GradientTransformation,
+                        *, remat: bool = True):
+    """Jitted, donated MoE train step: next-token CE + Switch load-balance
+    loss + router z-loss (coefficients from the config). Same sharding
+    story as the dense step, plus expert parallelism from the weight specs
+    (all-to-alls inserted by GSPMD at the dispatch/combine einsums)."""
+    from kukeon_tpu.models import moe
+
+    batch_sharding = NamedSharding(mesh, P((AXIS_DATA, AXIS_FSDP), AXIS_SEQ))
+
+    def loss_fn(params, tokens, targets, mask, positions):
+        fwd = moe.forward_with_aux
+        if remat:
+            fwd = jax.checkpoint(fwd, static_argnums=(1,))
+        logits, _, aux = fwd(params, cfg, tokens, positions)
+        ce = cross_entropy_loss(logits, targets, mask)
+        total = (ce
+                 + cfg.load_balance_coef * aux["load_balance"]
+                 + cfg.router_z_coef * aux["router_z"])
+        return total, (ce, aux)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, tokens, targets, mask):
+        B, S = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+        positions = jax.lax.with_sharding_constraint(positions, batch_sharding)
+        (loss, (ce, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, tokens, targets, mask, positions
+        )
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, "ce": ce,
+                   "load_balance": aux["load_balance"],
+                   "router_z": aux["router_z"]}
+        return (
+            TrainState(params=new_params, opt_state=new_opt, step=state.step + 1),
+            metrics,
         )
 
     return train_step, batch_sharding
