@@ -362,6 +362,35 @@ def _logits(params: Params, c: LlamaConfig, x: jnp.ndarray,
 
 # --- Forward -----------------------------------------------------------------
 
+def transformer_block(
+    x: jnp.ndarray,
+    w: dict,
+    cfg: LlamaConfig,
+    positions: jnp.ndarray,
+    attn_impl: str = "auto",
+) -> jnp.ndarray:
+    """One no-cache decoder block (attention + SwiGLU residual) over
+    [B, S, H]. Identical math to ``forward``'s cacheless layer step; exposed
+    standalone for the pipeline-parallel path (parallel/pipeline.py), whose
+    per-stage scan runs blocks outside forward's whole-model scan."""
+    c = cfg
+    B, S = x.shape[:2]
+    h = rms_norm(x, w["attn_norm"], c.rms_norm_eps)
+    q = _mm(h, w["wq"]).reshape(B, S, c.num_heads, c.head_dim)
+    k = _mm(h, w["wk"]).reshape(B, S, c.num_kv_heads, c.head_dim)
+    v = _mm(h, w["wv"]).reshape(B, S, c.num_kv_heads, c.head_dim)
+    q = apply_rope(q, positions, c.rope_theta)
+    k = apply_rope(k, positions, c.rope_theta)
+    attn = gqa_attention(
+        q, k, v, q_positions=positions, kv_positions=positions, impl=attn_impl
+    )
+    x = x + _mm(attn.reshape(B, S, c.q_dim), w["wo"])
+    h = rms_norm(x, w["mlp_norm"], c.rms_norm_eps)
+    gate = jax.nn.silu(_mm(h, w["w_gate"]).astype(jnp.float32)).astype(c.dtype)
+    up = _mm(h, w["w_up"])
+    return x + _mm(gate * up, w["w_down"])
+
+
 def forward(
     params: Params,
     cfg: LlamaConfig,

@@ -10,6 +10,11 @@ Canonical axis names for the whole framework (the scaling-book convention):
   - ``expert``:  expert parallelism (MoE: experts sharded over chips, token
                  dispatch/combine become all-to-alls inserted by GSPMD from
                  the einsum shardings — models/moe.py).
+  - ``pipe``:    pipeline parallelism (layer stages over chips; GPipe
+                 microbatch schedule with ppermute activation transfer —
+                 parallel/pipeline.py). Outermost axis: stage hops are the
+                 lowest-frequency, most latency-tolerant traffic, so they
+                 map to the outer interconnect dimension (DCN on multi-host).
 
 Serving uses (data, tensor); training adds fsdp/seq; MoE models add expert.
 On a TPU slice the mesh should be laid out so that ``tensor`` (highest-
@@ -29,6 +34,7 @@ AXIS_FSDP = "fsdp"
 AXIS_TENSOR = "tensor"
 AXIS_SEQ = "seq"
 AXIS_EXPERT = "expert"
+AXIS_PIPE = "pipe"
 
 
 def make_mesh(
@@ -37,25 +43,26 @@ def make_mesh(
     tensor: int = 1,
     seq: int = 1,
     expert: int = 1,
+    pipe: int = 1,
     *,
     devices=None,
 ) -> Mesh:
     """Build a mesh with the canonical axes; sizes must multiply to #devices."""
     devices = devices if devices is not None else jax.devices()
-    want = data * fsdp * tensor * seq * expert
+    want = data * fsdp * tensor * seq * expert * pipe
     if want != len(devices):
         raise ValueError(
-            f"mesh {data}x{fsdp}x{expert}x{seq}x{tensor}={want} != "
+            f"mesh {pipe}x{data}x{fsdp}x{expert}x{seq}x{tensor}={want} != "
             f"{len(devices)} devices"
         )
     # Auto axis types: GSPMD propagates shardings from the annotations we set
     # at jit boundaries (jax 0.9 defaults to Explicit mode, which turns
     # with_sharding_constraint into an assert — not what this codebase wants).
     return jax.make_mesh(
-        (data, fsdp, expert, seq, tensor),
-        (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR),
+        (pipe, data, fsdp, expert, seq, tensor),
+        (AXIS_PIPE, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR),
         devices=devices,
-        axis_types=(jax.sharding.AxisType.Auto,) * 5,
+        axis_types=(jax.sharding.AxisType.Auto,) * 6,
     )
 
 
