@@ -653,6 +653,13 @@ def cmd_doctor(args):
         checks.append(("cgroup-v2", "unavailable (limits degrade)"))
     chips = discover_chips()
     checks.append(("tpu-chips", f"{len(chips)} visible ({chips})" if chips else "none visible"))
+    from kukeon_tpu.runtime.devices import probe_tpu_runtime
+
+    state, detail = probe_tpu_runtime(
+        timeout_s=float(os.environ.get("KUKEON_DOCTOR_PROBE_TIMEOUT", "20"))
+    )
+    checks.append(("tpu-runtime",
+                   f"{state} — {detail}" if state != "ok" else f"ok — {detail}"))
     bin_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bin")
     for b in ("kukepause", "kukeshim", "kuketty", "kukecell", "kukenet"):
         ok = os.access(os.path.join(bin_dir, b), os.X_OK)

@@ -43,6 +43,42 @@ def discover_chips() -> list[int]:
     return sorted(int(os.path.basename(v)) for v in vfio)
 
 
+def probe_tpu_runtime(timeout_s: float = 20.0) -> tuple[str, str]:
+    """Live-runtime health probe: ('ok'|'wedged'|'unavailable', detail).
+
+    Visible device nodes prove nothing about the runtime plane — a wedged
+    libtpu/tunnel accepts the client and then blocks the first transfer
+    forever (observed in r4/r5: a bare 64 MB device_put hangs). The probe
+    runs a tiny device_put in a throwaway subprocess (libtpu is
+    single-process, and only a subprocess is reliably killable mid-hang)
+    and reports wall time, so `kuke doctor` distinguishes "no TPU" from
+    "TPU present but the runtime is wedged"."""
+    import subprocess
+    import sys
+
+    code = (
+        "import time, numpy, jax;"
+        "t0 = time.monotonic();"
+        "d = jax.device_put(numpy.ones((1024, 1024), numpy.int8));"
+        "jax.block_until_ready(d);"
+        "print(jax.default_backend(), round(time.monotonic() - t0, 2))"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return ("wedged",
+                f"1MB device_put did not finish in {timeout_s:.0f}s "
+                "(runtime hung / tunnel down — model cells will crash-loop)")
+    if out.returncode != 0:
+        err = out.stderr.strip().splitlines()
+        return "unavailable", (err[-1][:200] if err else f"rc={out.returncode}")
+    backend, dt = out.stdout.split()[-2:]
+    return "ok", f"backend={backend}, 1MB device_put in {dt}s"
+
+
 class TPUDeviceManager:
     """Chip accounting, persisted so daemon restarts keep allocations."""
 

@@ -204,7 +204,7 @@ class ServingCell:
         self.engine.precompile((prompt_len,))
         self.engine.warmup(prompt_len)
 
-    def generate(self, req: dict) -> dict:
+    def _parse_generate(self, req: dict):
         from kukeon_tpu.serving import SamplingParams
 
         if "promptTokens" in req:
@@ -219,6 +219,10 @@ class ServingCell:
             top_p=float(req.get("topP", 1.0)),
             max_new_tokens=int(req.get("maxNewTokens", 128)),
         )
+        return prompt, sp
+
+    def generate(self, req: dict) -> dict:
+        prompt, sp = self._parse_generate(req)
         t0 = time.monotonic()
         tokens = self.engine.generate(prompt, sp)
         dt = time.monotonic() - t0
@@ -229,6 +233,41 @@ class ServingCell:
             "text": self.tokenizer.decode(tokens),
             "numTokens": len(tokens),
             "seconds": round(dt, 4),
+        }
+
+    def generate_stream(self, req: dict):
+        """Streaming generation: yields one JSON-line dict per token batch
+        as the engine emits them (an agent session reads tokens as they
+        decode instead of waiting for the full completion), then a terminal
+        record with the aggregate fields of :meth:`generate`."""
+        import queue as _q
+
+        prompt, sp = self._parse_generate(req)
+        events: _q.Queue = _q.Queue()
+        t0 = time.monotonic()
+        r = self.engine.submit(prompt, sp,
+                               emit=lambda tok, done: events.put((tok, done)))
+        tokens: list[int] = []
+        while True:
+            tok, done = events.get()
+            if tok >= 0:
+                tokens.append(tok)
+                yield {"token": tok, "text": self.tokenizer.decode([tok])}
+            if done:
+                break
+        if r.error is not None:
+            yield {"error": f"{type(r.error).__name__}: {r.error}"}
+            return
+        dt = time.monotonic() - t0
+        with self._stats_lock:
+            self.total_tokens += len(tokens)
+        yield {
+            "done": True,
+            "tokens": tokens,
+            "text": self.tokenizer.decode(tokens),
+            "numTokens": len(tokens),
+            "seconds": round(dt, 4),
+            "cancelled": bool(r.cancelled),
         }
 
     def stats(self) -> dict:
@@ -376,11 +415,36 @@ def make_handler(cell: ServingCell):
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
+                if (self.path == "/v1/generate" and req.get("stream")
+                        and hasattr(cell, "generate_stream")):
+                    self._stream(cell.generate_stream(req))
+                    return
                 self._send(200, fn(req))
             except ValueError as e:
                 self._send(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — server must keep serving
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _stream(self, gen):
+            """Newline-delimited JSON, framed by connection close (the
+            handler speaks HTTP/1.0). The first record is pulled before
+            headers go out so parse errors still surface as a clean 400."""
+            import itertools
+
+            try:
+                first = next(gen)
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+                return
+            except StopIteration:
+                self._send(500, {"error": "empty stream"})
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.end_headers()
+            for obj in itertools.chain([first], gen):
+                self.wfile.write((json.dumps(obj) + "\n").encode())
+                self.wfile.flush()
 
     return Handler
 

@@ -285,6 +285,22 @@ spec:
     out = json.loads(r.read())
     assert out["numTokens"] == 4
 
+    # Streaming: newline-delimited JSON, one record per token + a terminal
+    # record that matches the non-streaming aggregate shape.
+    body = json.dumps({"prompt": "hi", "maxNewTokens": 4, "stream": True}).encode()
+    r = urllib.request.urlopen(
+        urllib.request.Request("http://127.0.0.1:9471/v1/generate", data=body,
+                               headers={"Content-Type": "application/json"}),
+        timeout=60,
+    )
+    assert r.headers.get("Content-Type") == "application/x-ndjson"
+    records = [json.loads(ln) for ln in r.read().splitlines() if ln.strip()]
+    tok_records, final = records[:-1], records[-1]
+    assert len(tok_records) == 4
+    assert all("token" in t for t in tok_records)
+    assert final["done"] is True and final["numTokens"] == 4
+    assert final["tokens"] == [t["token"] for t in tok_records]
+
     d.kuke("delete", "cell", "llm", "--force")
     status = json.loads(d.kuke("--json", "status").stdout)
     assert status["tpuChips"]["free"] == 2
@@ -656,3 +672,37 @@ def test_attach_through_real_pty(daemon):
     assert "pty-marker-42" in cap
     assert "second-session-42" in cap
     d.kuke("delete", "cell", "term", "--force")
+
+
+def test_doctor_tpu_runtime_probe(monkeypatch):
+    """probe_tpu_runtime distinguishes a live runtime from a wedged one
+    (r4/r5 failure family: device nodes visible, first transfer hangs)."""
+    import os as _os
+
+    from kukeon_tpu.runtime.devices import probe_tpu_runtime
+
+    # Pin the child to CPU: the probe must exercise a REAL backend, and the
+    # CPU platform is the one this CI host can always answer on. The axon
+    # sitecustomize would override JAX_PLATFORMS, so strip it.
+    parts = [p for p in _os.environ.get("PYTHONPATH", "").split(_os.pathsep)
+             if p and "axon" not in p]
+    monkeypatch.setenv("PYTHONPATH", _os.pathsep.join(parts))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    state, detail = probe_tpu_runtime(timeout_s=120.0)
+    assert state == "ok", detail
+    assert "backend=cpu" in detail
+
+    # A wedged runtime = the child never returns: simulated with a child
+    # that blocks forever (what a hung libtpu transfer looks like).
+    import subprocess as _sp
+
+    real_run = _sp.run
+
+    def hang(cmd, **kw):
+        return real_run([cmd[0], "-c", "import time; time.sleep(60)"],
+                        **{**kw, "timeout": kw.get("timeout")})
+
+    monkeypatch.setattr(_sp, "run", hang)
+    state, detail = probe_tpu_runtime(timeout_s=0.5)
+    assert state == "wedged"
+    assert "did not finish" in detail
