@@ -212,6 +212,46 @@ def phase_serve(args) -> None:
     }), flush=True)
 
 
+def phase_embed(args) -> None:
+    """Embedding-cell throughput (BASELINE config 5: bge-base embedding
+    serving): sequences/s for batched ~128-token inputs."""
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from kukeon_tpu.models import bert
+    from kukeon_tpu.parallel import auto_mesh_shape, make_mesh
+    from kukeon_tpu.serving import EmbeddingEngine
+
+    backend = jax.default_backend()
+    n_chips = len(jax.devices())
+    shape = auto_mesh_shape(n_chips)
+    mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
+
+    if backend == "cpu":
+        cfg, model_name, batch, seq_len, n_batches = (
+            bert.bge_tiny(), "bge-tiny (cpu smoke)", 8, 32, 2)
+    else:
+        cfg, model_name, batch, seq_len, n_batches = (
+            bert.bge_base(), "bge-base", 32, 128, 8)
+    params = bert.init_params(jax.random.key(0), cfg)
+    engine = EmbeddingEngine(cfg, params, mesh, batch_size=batch)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, size=seq_len).astype(np.int32)
+               for _ in range(batch)]
+    engine.warmup((seq_len,))
+    t0 = time.monotonic()
+    for _ in range(n_batches):
+        vecs = engine.embed_batch(prompts)
+    dt = time.monotonic() - t0
+    print(json.dumps({
+        "backend": backend, "model": model_name, "dim": int(vecs.shape[1]),
+        "batch": batch, "seq_len": seq_len,
+        "seq_per_s": round(batch * n_batches / dt, 1),
+    }), flush=True)
+
+
 # --- cold-start phase ---------------------------------------------------------
 
 def _tail_file(path: str, limit: int = 2500) -> str:
@@ -353,7 +393,7 @@ def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--phase", default="all", choices=["all", "serve"])
+    ap.add_argument("--phase", default="all", choices=["all", "serve", "embed"])
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--decode-chunk", type=int,
                     default=int(os.environ.get("KUKEON_BENCH_CHUNK", "16")))
@@ -366,6 +406,9 @@ def main() -> None:
 
     if args.phase == "serve":
         phase_serve(args)
+        return
+    if args.phase == "embed":
+        phase_embed(args)
         return
 
     backend, n_chips = detect_backend()
@@ -412,6 +455,22 @@ def main() -> None:
     # r4's measured 8B TPU throughput was discarded when cold-start raised).
     _log(f"serve phase result: {json.dumps(serve)}")
 
+    # Embedding throughput (config 5) — auxiliary measurement, never fatal.
+    embedding = None
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--phase", "embed"],
+            capture_output=True, text=True, timeout=1200, cwd=REPO,
+            env=subprocess_env(),
+        )
+        if out.returncode == 0:
+            embedding = json.loads(out.stdout.strip().splitlines()[-1])
+            _log(f"embed phase result: {json.dumps(embedding)}")
+        else:
+            _log(f"embed phase failed rc={out.returncode}:\n{out.stderr[-1500:]}")
+    except Exception as e:  # noqa: BLE001
+        _log(f"embed phase error: {e}")
+
     baseline_share = 1500.0 * serve["n_chips"] / 8.0
     result = {
         "metric": "aggregate decode tok/s, %d concurrent sessions, %s, %d chip(s) [%s]"
@@ -441,6 +500,8 @@ def main() -> None:
     if cold_errors:
         cold["error"] = "; ".join(cold_errors)[-500:]
     result["cold_start"] = cold
+    if embedding is not None:
+        result["embedding"] = embedding
 
     # TPU measurement history (committed): a genuine TPU number must survive
     # a later flaky-tunnel run. On a TPU measurement, append it; on a
