@@ -61,6 +61,9 @@ class MoEConfig:
     dtype: Any = jnp.bfloat16
     router_z_coef: float = 1e-3
     load_balance_coef: float = 1e-2
+    # Read by ServingEngine's pallas auto-routing; the MoE forward has no
+    # pallas path, so this stays False (the engine requires the field).
+    int8_pallas: bool = False
 
     @property
     def q_dim(self) -> int:

@@ -74,10 +74,12 @@ def _quant_scale_spec(spec: P, q, s) -> P:
     return P(spec[0] if s.shape[0] == q.shape[0] else spec[1])
 
 
-def param_shardings(params, mesh: Mesh, fsdp: bool = False):
+def param_shardings(params, mesh: Mesh, fsdp: bool = False, specs=None):
     """NamedSharding pytree matching ``params``' structure (quantized
-    {"q","s"} leaves expanded), without touching any device."""
-    specs = specs_for_params(params, fsdp)
+    {"q","s"} leaves expanded), without touching any device. ``specs``
+    overrides the Llama defaults (e.g. moe_specs_for_params)."""
+    if specs is None:
+        specs = specs_for_params(params, fsdp)
 
     def expand(spec, leaf):
         if isinstance(leaf, dict) and "q" in leaf:
@@ -95,7 +97,8 @@ def param_shardings(params, mesh: Mesh, fsdp: bool = False):
     )
 
 
-def shard_params(params, mesh: Mesh, fsdp: bool = False, threads: int = 4):
+def shard_params(params, mesh: Mesh, fsdp: bool = False, threads: int = 4,
+                 specs=None):
     """Device-put a param pytree with the canonical shardings.
 
     Quantized leaves ({"q": int8 matrix, "s": scale}) inherit the matrix
@@ -105,7 +108,7 @@ def shard_params(params, mesh: Mesh, fsdp: bool = False, threads: int = 4):
     this changes nothing measurable, but on a tunneled/remote chip the
     per-transfer RPC latency dominates and concurrent streams pipeline it
     (an 8B int8 tree is ~300 leaves; serial puts pay ~300 round trips)."""
-    shardings = param_shardings(params, mesh, fsdp)
+    shardings = param_shardings(params, mesh, fsdp, specs=specs)
     flat_s, treedef = jax.tree.flatten(shardings)
     flat_p, _ = jax.tree.flatten(params)
 

@@ -87,14 +87,20 @@ def _bust_compilation_cache() -> bool:
     return had
 
 
+MOE_MODELS = set()
+
+
 def _register_models():
-    from kukeon_tpu.models import bert, llama
+    from kukeon_tpu.models import bert, llama, moe
 
     MODELS.update({
         "tiny": llama.llama_tiny,
         "llama3-1b": llama.llama3_1b,
         "llama3-8b": llama.llama3_8b,
+        "mixtral-tiny": moe.moe_tiny,
+        "mixtral-8x7b": moe.mixtral_8x7b,
     })
+    MOE_MODELS.update({"mixtral-tiny", "mixtral-8x7b"})
     EMBEDDING_MODELS.update({
         "bge-base": bert.bge_base,
         "bge-tiny": bert.bge_tiny,
@@ -136,7 +142,25 @@ class ServingCell:
         shape = auto_mesh_shape(n)
         mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
 
-        if checkpoint:
+        forward_fn = None
+        param_specs = None
+        if model in MOE_MODELS:
+            # MoE family: same engine, moe forward + expert-aware specs.
+            # int8 weights / int8-KV / external checkpoints are llama-tree
+            # features the MoE path doesn't have yet — fail loudly rather
+            # than serving garbage.
+            if quantize or kv_cache_int8 or checkpoint:
+                raise SystemExit(
+                    f"model {model!r} does not support int8/checkpoint "
+                    "serving yet (bf16/f32 random-init only)"
+                )
+            from kukeon_tpu.models import moe
+            from kukeon_tpu.parallel import moe_specs_for_params
+
+            params = moe.init_params(jax.random.key(seed), cfg)
+            forward_fn = moe.forward
+            param_specs = moe_specs_for_params(params)
+        elif checkpoint:
             params, cfg = self._load_checkpoint(checkpoint, cfg, quantize)
         elif quantize:
             # Random-init directly in int8 on the host: an 8B bf16 tree
@@ -155,6 +179,7 @@ class ServingCell:
             cfg, params, mesh, num_slots=num_slots,
             max_seq_len=max_seq_len or min(cfg.max_seq_len, 4096),
             kv_cache_int8=kv_cache_int8, async_load=True,
+            forward_fn=forward_fn, param_specs=param_specs,
         )
         from kukeon_tpu.serving.tokenizer import load_tokenizer
 

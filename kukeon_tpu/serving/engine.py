@@ -130,7 +130,16 @@ class ServingEngine:
         int8_pallas: bool | None = None,
         kv_cache_int8: bool = False,
         async_load: bool = False,
+        forward_fn=None,
+        param_specs=None,
     ):
+        # Model pluggability: any forward with llama.forward's signature
+        # ((params, cfg, tokens, positions, cache) -> (logits, cache')) and
+        # the shared KVCache layout serves through this engine —
+        # models/moe.py is the second family. ``param_specs`` supplies the
+        # matching PartitionSpec tree (default: the Llama specs).
+        self._forward = forward_fn or llama.forward
+        self._param_specs = param_specs
         # int8_pallas=None -> auto: route quantized decode matmuls through
         # the Pallas kernel on a single-chip TPU mesh when the operator opts
         # in (KUKEON_INT8_PALLAS=1). Microbenchmarks on v5e measured the
@@ -177,7 +186,7 @@ class ServingEngine:
             raise ValueError("ServingEngine requires a mesh (use make_mesh(tensor=1) for one device)")
         # Abstract (shape+sharding) view of the params, available before any
         # byte reaches the device — what precompile() lowers against.
-        self._shardings = shd.param_shardings(params, mesh)
+        self._shardings = shd.param_shardings(params, mesh, specs=self._param_specs)
         self._abstract_params = jax.tree.map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             params, self._shardings,
@@ -192,7 +201,8 @@ class ServingEngine:
 
             def _load():
                 try:
-                    self.params = shd.shard_params(params, mesh)
+                    self.params = shd.shard_params(
+                        params, mesh, specs=self._param_specs)
                     with jax.set_mesh(mesh):
                         self.state = self._init_state()
                 except Exception as e:  # noqa: BLE001 — surfaced by _ensure_loaded
@@ -203,7 +213,8 @@ class ServingEngine:
             threading.Thread(target=_load, daemon=True,
                              name="engine-weight-load").start()
         else:
-            self.params = shd.shard_params(params, mesh)
+            self.params = shd.shard_params(params, mesh,
+                                           specs=self._param_specs)
             with jax.set_mesh(mesh):
                 self.state = self._init_state()
             self._loaded.set()
@@ -261,13 +272,14 @@ class ServingEngine:
 
     def _build_programs(self):
         cfg = self.cfg
+        fwd = self._forward
 
         def prefill(params, tokens, length, key, temp, top_k, top_p):
             """tokens [1, S_bucket] -> (first sampled token, kv block)."""
             S = tokens.shape[1]
             positions = jnp.arange(S, dtype=jnp.int32)[None, :]
             cache = llama.KVCache.create(cfg, 1, S)
-            logits, cache = llama.forward(params, cfg, tokens, positions, cache)
+            logits, cache = fwd(params, cfg, tokens, positions, cache)
             last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, keepdims=False)
             first = sample_per_slot(
                 last[None, :], key, temp[None], top_k[None], top_p[None]
@@ -313,7 +325,7 @@ class ServingEngine:
                 tokens = state.tokens[:, None]
                 lengths_before = state.cache.lengths
                 positions = lengths_before[:, None]
-                logits, cache = llama.forward(
+                logits, cache = fwd(
                     params, cfg, tokens, positions, state.cache
                 )
                 # Inactive slots must not advance their cache length.
