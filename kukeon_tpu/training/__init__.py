@@ -5,3 +5,9 @@ from kukeon_tpu.training.train_step import (  # noqa: F401
     make_moe_train_step,
     make_train_step,
 )
+from kukeon_tpu.training.checkpointing import (  # noqa: F401
+    abstract_like,
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)

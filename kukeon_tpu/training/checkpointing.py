@@ -1,0 +1,87 @@
+"""Training checkpoint/resume: orbax-backed TrainState persistence.
+
+The runtime side already has metadata-first resume (every resource record a
+JSON file; SURVEY.md §5.4); this is the compute-side analog for training
+jobs: step-numbered checkpoints of the full TrainState (params + optimizer
+state + step) that restore DIRECTLY into the mesh shardings of the resuming
+job — restore is a sharded read (each host/device reads its own slices),
+and resuming on a different mesh layout reshards transparently because the
+abstract target carries the new NamedShardings.
+
+Layout: ``<root>/step_00000042/`` per checkpoint, newest wins for resume.
+Writes go through orbax's atomic-rename protocol, so a killed writer never
+leaves a checkpoint that :func:`latest_step` would pick up.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import jax
+
+from kukeon_tpu.training.train_step import TrainState
+
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+
+
+def _step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{step:08d}")
+
+
+def latest_step(root: str) -> int | None:
+    """Newest complete checkpoint step under ``root``; None when empty."""
+    try:
+        entries = os.listdir(root)
+    except FileNotFoundError:
+        return None
+    steps = []
+    for e in entries:
+        m = _STEP_RE.match(e)
+        # Orbax writes to a tmp name and renames; only final names match.
+        if m and os.path.isdir(os.path.join(root, e)):
+            steps.append(int(m.group(1)))
+    return max(steps) if steps else None
+
+
+def save_checkpoint(root: str, state: TrainState) -> str:
+    """Write ``state`` as ``<root>/step_<state.step>``; returns the path."""
+    import orbax.checkpoint as ocp
+
+    step = int(state.step)
+    path = _step_dir(root, step)
+    os.makedirs(root, exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, state)
+    ckptr.wait_until_finished()
+    return path
+
+
+def abstract_like(state: TrainState) -> TrainState:
+    """ShapeDtypeStruct mirror of a live state, carrying its shardings —
+    the restore target. Build the template with create_train_state on the
+    RESUMING job's mesh; restore then reads straight into that layout."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        state,
+    )
+
+
+def restore_checkpoint(root: str, template: TrainState,
+                       step: int | None = None) -> TrainState:
+    """Restore the checkpoint at ``step`` (default: newest) into the
+    template's shardings. ``template`` is a live or abstract TrainState of
+    identical structure (e.g. a freshly created one on the resuming mesh)."""
+    import orbax.checkpoint as ocp
+
+    if step is None:
+        step = latest_step(root)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {root}")
+    abstract = template if _is_abstract(template) else abstract_like(template)
+    return ocp.StandardCheckpointer().restore(_step_dir(root, step), abstract)
+
+
+def _is_abstract(tree) -> bool:
+    leaves = jax.tree.leaves(tree)
+    return bool(leaves) and isinstance(leaves[0], jax.ShapeDtypeStruct)
