@@ -138,6 +138,116 @@ def load_params(checkpoint_dir: str, cfg: LlamaConfig | None = None,
     return params, cfg
 
 
+# --- Mixtral (sparse MoE) -----------------------------------------------------
+
+def moe_config_from_hf(checkpoint_dir: str):
+    """config.json (MixtralForCausalLM layout) -> MoEConfig."""
+    from kukeon_tpu.models.moe import MoEConfig
+
+    with open(os.path.join(checkpoint_dir, "config.json")) as f:
+        hf = json.load(f)
+    head_dim = hf.get("head_dim") or (
+        hf["hidden_size"] // hf["num_attention_heads"]
+    )
+    return MoEConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=head_dim,
+        num_experts=hf.get("num_local_experts", 8),
+        experts_per_token=hf.get("num_experts_per_tok", 2),
+        rope_theta=hf.get("rope_theta", 1_000_000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+        max_seq_len=hf.get("max_position_embeddings", 8192),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+    )
+
+
+def load_moe_params(checkpoint_dir: str, cfg=None,
+                    dtype=jnp.bfloat16):
+    """HF Mixtral checkpoint -> (moe params, MoEConfig).
+
+    Name mapping (HF Linear is [out, in]; our matmuls take [in, out]):
+
+      model.layers.N.block_sparse_moe.gate.weight   [E, H] -> router [L, H, E]
+      ...experts.E.w1.weight [I, H] -> w_gate [L, E, H, I]  (T per expert)
+      ...experts.E.w3.weight [I, H] -> w_up   [L, E, H, I]
+      ...experts.E.w2.weight [H, I] -> w_down [L, E, I, H]
+
+    Attention / norms / embed map exactly as Llama (same trunk).
+    """
+    import dataclasses
+
+    from safetensors import safe_open
+
+    cfg = cfg or moe_config_from_hf(checkpoint_dir)
+    cfg = dataclasses.replace(cfg, dtype=dtype)
+    where = _open_shards(checkpoint_dir)
+
+    by_shard: dict[str, list[str]] = {}
+    for name, shard in where.items():
+        by_shard.setdefault(shard, []).append(name)
+    raw: dict[str, np.ndarray] = {}
+    for shard, names in by_shard.items():
+        with safe_open(shard, framework="numpy") as f:
+            for name in names:
+                raw[name] = f.get_tensor(name)
+
+    L, E = cfg.num_layers, cfg.num_experts
+
+    def stack(fmt: str, transpose: bool) -> jnp.ndarray:
+        tensors = []
+        for i in range(L):
+            t = raw.pop(fmt.format(i))
+            tensors.append(t.T if transpose else t)
+        return jnp.asarray(np.stack(tensors), dtype)
+
+    def stack_experts(w_name: str) -> jnp.ndarray:
+        layers = []
+        for i in range(L):
+            experts = []
+            for e in range(E):
+                t = raw.pop(
+                    f"model.layers.{i}.block_sparse_moe.experts.{e}.{w_name}.weight"
+                )
+                experts.append(t.T)
+            layers.append(np.stack(experts))
+        return jnp.asarray(np.stack(layers), dtype)
+
+    p = "model.layers.{}."
+    params = {
+        "embed": jnp.asarray(raw.pop("model.embed_tokens.weight"), dtype),
+        "layers": {
+            "attn_norm": stack(p + "input_layernorm.weight", False),
+            "wq": stack(p + "self_attn.q_proj.weight", True),
+            "wk": stack(p + "self_attn.k_proj.weight", True),
+            "wv": stack(p + "self_attn.v_proj.weight", True),
+            "wo": stack(p + "self_attn.o_proj.weight", True),
+            "mlp_norm": stack(p + "post_attention_layernorm.weight", False),
+            # Router stays f32: routing decisions must not wobble with the
+            # activation dtype (models/moe.py keeps it f32 at init too).
+            "router": jnp.asarray(
+                np.stack([
+                    raw.pop(f"model.layers.{i}.block_sparse_moe.gate.weight").T
+                    for i in range(L)
+                ]), jnp.float32),
+            "w_gate": stack_experts("w1"),
+            "w_up": stack_experts("w3"),
+            "w_down": stack_experts("w2"),
+        },
+        "final_norm": jnp.asarray(raw.pop("model.norm.weight"), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(raw.pop("lm_head.weight").T, dtype)
+    raw.pop("lm_head.weight", None)
+    if raw:
+        raise ValueError(f"unmapped tensors in checkpoint: {sorted(raw)[:5]}")
+    return params, cfg
+
+
 # --- streaming int8 load ------------------------------------------------------
 
 def load_params_quantized(checkpoint_dir: str,

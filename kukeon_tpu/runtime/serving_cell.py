@@ -146,18 +146,23 @@ class ServingCell:
         param_specs = None
         if model in MOE_MODELS:
             # MoE family: same engine, moe forward + expert-aware specs.
-            # int8 weights / int8-KV / external checkpoints are llama-tree
-            # features the MoE path doesn't have yet — fail loudly rather
-            # than serving garbage.
-            if quantize or kv_cache_int8 or checkpoint:
+            # int8 weights / int8-KV are llama-tree features the MoE path
+            # doesn't have yet — fail loudly rather than serving garbage.
+            if quantize or kv_cache_int8:
                 raise SystemExit(
-                    f"model {model!r} does not support int8/checkpoint "
-                    "serving yet (bf16/f32 random-init only)"
+                    f"model {model!r} does not support int8 serving yet"
                 )
-            from kukeon_tpu.models import moe
+            from kukeon_tpu.models import hf_convert, moe
             from kukeon_tpu.parallel import moe_specs_for_params
 
-            params = moe.init_params(jax.random.key(seed), cfg)
+            if checkpoint:
+                params, cfg = hf_convert.load_moe_params(
+                    checkpoint, dtype=cfg.dtype
+                )
+                if max_seq_len:
+                    cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
+            else:
+                params = moe.init_params(jax.random.key(seed), cfg)
             forward_fn = moe.forward
             param_specs = moe_specs_for_params(params)
         elif checkpoint:

@@ -233,3 +233,66 @@ def test_moe_serving_cell_http_roundtrip():
     with pytest.raises(SystemExit, match="int8"):
         ServingCell("mixtral-tiny", num_slots=2, max_seq_len=64,
                     checkpoint=None, dtype="int8")
+
+
+def test_hf_mixtral_checkpoint_roundtrip(tmp_path, tiny):
+    """moe params written in the HF Mixtral safetensors layout load back
+    identically through hf_convert.load_moe_params (incl. the transposes),
+    and the loaded tree's forward matches the original's."""
+    import json
+
+    from safetensors.numpy import save_file
+
+    from kukeon_tpu.models import hf_convert
+
+    cfg, params = tiny
+    L, E = cfg.num_layers, cfg.num_experts
+    flat = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    lw = params["layers"]
+    for i in range(L):
+        p = f"model.layers.{i}."
+        flat[p + "input_layernorm.weight"] = np.asarray(lw["attn_norm"][i], np.float32)
+        flat[p + "post_attention_layernorm.weight"] = np.asarray(lw["mlp_norm"][i], np.float32)
+        for ours, hf in (("wq", "q_proj"), ("wk", "k_proj"),
+                         ("wv", "v_proj"), ("wo", "o_proj")):
+            flat[p + f"self_attn.{hf}.weight"] = np.ascontiguousarray(
+                np.asarray(lw[ours][i], np.float32).T)
+        flat[p + "block_sparse_moe.gate.weight"] = np.ascontiguousarray(
+            np.asarray(lw["router"][i], np.float32).T)
+        for e in range(E):
+            q = f"{p}block_sparse_moe.experts.{e}."
+            flat[q + "w1.weight"] = np.ascontiguousarray(
+                np.asarray(lw["w_gate"][i, e], np.float32).T)
+            flat[q + "w3.weight"] = np.ascontiguousarray(
+                np.asarray(lw["w_up"][i, e], np.float32).T)
+            flat[q + "w2.weight"] = np.ascontiguousarray(
+                np.asarray(lw["w_down"][i, e], np.float32).T)
+    save_file(flat, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps({
+        "architectures": ["MixtralForCausalLM"],
+        "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": L, "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads, "head_dim": cfg.head_dim,
+        "num_local_experts": E, "num_experts_per_tok": cfg.experts_per_token,
+        "rope_theta": cfg.rope_theta, "rms_norm_eps": cfg.rms_norm_eps,
+        "max_position_embeddings": cfg.max_seq_len,
+        "tie_word_embeddings": True,
+    }))
+
+    loaded, lcfg = hf_convert.load_moe_params(str(tmp_path), dtype=jnp.float32)
+    assert lcfg.num_experts == E and lcfg.experts_per_token == cfg.experts_per_token
+    # capacity_factor is a serving knob, not an HF field; align for parity.
+    lcfg = dataclasses.replace(lcfg, capacity_factor=cfg.capacity_factor)
+    for a, b in zip(jax.tree.leaves(loaded), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0, rtol=0)
+
+    tokens = jax.random.randint(jax.random.key(8), (1, 8), 0, cfg.vocab_size)
+    positions = jnp.arange(8, dtype=jnp.int32)[None, :]
+    want, _ = moe.forward(params, cfg, tokens, positions)
+    got, _ = moe.forward(loaded, lcfg, tokens, positions)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
