@@ -706,3 +706,47 @@ def test_doctor_tpu_runtime_probe(monkeypatch):
     state, detail = probe_tpu_runtime(timeout_s=0.5)
     assert state == "wedged"
     assert "did not finish" in detail
+
+
+def test_moe_model_cell_e2e(daemon):
+    """A mixtral (MoE) model cell boots through the same manifest path and
+    answers /v1/generate — the model registry + pluggable engine running
+    under the real daemon."""
+    import urllib.request
+
+    d = daemon
+    manifest = """
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {name: moe}
+spec:
+  model: {model: mixtral-tiny, chips: 1, port: 9478, numSlots: 2,
+          maxSeqLen: 128, hostNetwork: true}
+"""
+    d.kuke("apply", "-f", "-", stdin_data=manifest)
+    deadline = time.monotonic() + 120.0
+    healthy = False
+    while time.monotonic() < deadline:
+        try:
+            r = urllib.request.urlopen("http://127.0.0.1:9478/v1/health", timeout=1)
+            healthy = json.loads(r.read())["status"] == "ok"
+            break
+        except OSError:
+            rec = json.loads(d.kuke("--json", "get", "cells", "moe").stdout)
+            st = rec["status"]["containers"][0]
+            if st["state"] == "exited":
+                log = d.kuke("log", "moe", "--container", "model-server",
+                             check=False).stdout
+                raise AssertionError(
+                    f"moe server exited ({st['exitCode']}):\n{log}")
+            time.sleep(1.0)
+    assert healthy, "moe model server did not become healthy in 120s"
+
+    body = json.dumps({"prompt": "hello", "maxNewTokens": 3}).encode()
+    r = urllib.request.urlopen(
+        urllib.request.Request("http://127.0.0.1:9478/v1/generate", data=body,
+                               headers={"Content-Type": "application/json"}),
+        timeout=60,
+    )
+    assert json.loads(r.read())["numTokens"] == 3
+    d.kuke("delete", "cell", "moe", "--force")
