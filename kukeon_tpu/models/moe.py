@@ -131,13 +131,29 @@ def init_params(key: jax.Array, cfg: MoEConfig) -> Params:
     return params
 
 
-def _capacity(cfg: MoEConfig, n_tokens: int) -> int:
-    cap = int(cfg.capacity_factor * n_tokens * cfg.experts_per_token
-              / cfg.num_experts)
-    return max(cap, cfg.experts_per_token)
+def _capacity(cfg: MoEConfig, n_tokens: int, inference: bool = False) -> int:
+    """Per-expert token capacity.
+
+    Training uses the GShard drop policy (capacity_factor × fair share;
+    overflow tokens fall through the residual — standard, and the
+    load-balance loss keeps drops rare). Inference must not silently drop
+    expert compute (reference Mixtral always runs both top-k experts):
+    decode-sized batches get FULL capacity (C = N, exact for any routing —
+    the dispatch tensor is a few KB), and prefill gets a 2× wider buffer
+    than training, making drops possible only under extreme routing
+    concentration (>8× the fair share for the 8x7B config)."""
+    E, K = cfg.num_experts, cfg.experts_per_token
+    if inference:
+        if n_tokens <= 64:
+            return n_tokens
+        factor = max(cfg.capacity_factor, 2.0) * 2.0
+        return min(n_tokens, max(int(factor * n_tokens * K / E), K))
+    cap = int(cfg.capacity_factor * n_tokens * K / E)
+    return max(cap, K)
 
 
-def moe_block(h: jnp.ndarray, w: dict, cfg: MoEConfig) -> tuple[jnp.ndarray, dict]:
+def moe_block(h: jnp.ndarray, w: dict, cfg: MoEConfig,
+              inference: bool = False) -> tuple[jnp.ndarray, dict]:
     """Sparse-MoE SwiGLU over [B, S, H] -> ([B, S, H], aux losses).
 
     GShard dense-dispatch: top-k routing -> static-capacity one-hot dispatch
@@ -150,7 +166,7 @@ def moe_block(h: jnp.ndarray, w: dict, cfg: MoEConfig) -> tuple[jnp.ndarray, dic
     B, S, H = h.shape
     N = B * S
     E, K = c.num_experts, c.experts_per_token
-    C = _capacity(c, N)
+    C = _capacity(c, N, inference)
     x = h.reshape(N, H)
 
     router_logits = x.astype(jnp.float32) @ w["router"]          # [N, E]
@@ -203,9 +219,15 @@ def forward_with_aux(
     """Run the MoE decoder; returns (logits, cache', aux-loss dict).
 
     Cache semantics identical to ``llama.forward`` (same KVCache layout, so
-    the serving engine's insert/decode programs carry over unchanged)."""
+    the serving engine's insert/decode programs carry over unchanged).
+
+    A cache marks the inference path: expert capacity switches to the
+    no-drop/wide policy (see :func:`_capacity`) — serving must not silently
+    zero overflow tokens' expert compute the way the training drop policy
+    legitimately does."""
     c = cfg
     B, S = tokens.shape
+    inference = cache is not None
     x = _embed(params, tokens, c.dtype)
     offsets = cache.lengths if cache is not None else None
 
@@ -242,7 +264,7 @@ def forward_with_aux(
         x = x + _mm(attn.reshape(B, S, c.q_dim), w["wo"])
 
         h = rms_norm(x, w["mlp_norm"], c.rms_norm_eps)
-        y, aux = moe_block(h, w, c)
+        y, aux = moe_block(h, w, c, inference=inference)
         x = x + y
         return (x, lb_sum + aux["load_balance"], z_sum + aux["router_z"]), new_layer_cache
 

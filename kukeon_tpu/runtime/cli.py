@@ -976,6 +976,13 @@ def main(argv=None) -> int:
     except KukeonError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    except BrokenPipeError:
+        # `kuke ... | head` closed the pipe: normal unix behavior, not an
+        # error. Point stdout at devnull so interpreter teardown doesn't
+        # raise again while flushing.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        return 0
 
 
 if __name__ == "__main__":

@@ -76,6 +76,13 @@ def probe_tpu_runtime(timeout_s: float = 20.0) -> tuple[str, str]:
         err = out.stderr.strip().splitlines()
         return "unavailable", (err[-1][:200] if err else f"rc={out.returncode}")
     backend, dt = out.stdout.split()[-2:]
+    if backend != "tpu" and discover_chips():
+        # TPU init failed non-fatally and JAX fell back to another backend:
+        # chips are visible but NOT usable — "ok backend=cpu" would read as
+        # healthy while model cells pinned to the TPU crash-loop.
+        return ("unavailable",
+                f"chips visible but backend={backend} (TPU init failed; "
+                "check libtpu / driver versions)")
     return "ok", f"backend={backend}, 1MB device_put in {dt}s"
 
 

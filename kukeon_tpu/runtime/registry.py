@@ -292,7 +292,7 @@ class RegistryClient:
         ):
             return self._send(method, path_or_url, data, content_type,
                               timeout, retry_auth=False, ok_codes=ok_codes)
-        if status >= 400 and not (200 <= status < 400 or status in ok_codes):
+        if status >= 400 and status not in ok_codes:
             raise KukeonError(
                 f"registry {self.registry}: {method} {split.path} -> {status}"
             )

@@ -278,11 +278,18 @@ class ServingCell:
         r = self.engine.submit(prompt, sp,
                                emit=lambda tok, done: events.put((tok, done)))
         tokens: list[int] = []
+        emitted = ""
         while True:
             tok, done = events.get()
             if tok >= 0:
                 tokens.append(tok)
-                yield {"token": tok, "text": self.tokenizer.decode([tok])}
+                # Incremental decode by prefix diff: decoding ids in
+                # isolation breaks BPE merging (word-boundary markers,
+                # multi-token UTF-8), so concatenated per-token text would
+                # not equal the final decode.
+                full = self.tokenizer.decode(tokens)
+                delta, emitted = full[len(emitted):], full
+                yield {"token": tok, "text": delta}
             if done:
                 break
         if r.error is not None:

@@ -537,18 +537,30 @@ class ServingEngine:
                     raise
 
     def _fail_all(self, exc: Exception):
-        """Fail every active + pending request so callers don't hang."""
-        for slot, req in list(self._active_requests()):
+        """Fail every active + pending request so callers don't hang.
+
+        Streaming consumers block on their emit channel, not on ``done`` —
+        each one must receive the terminal (-1, True) event or it waits
+        forever (same contract as the cancel paths)."""
+
+        def finish(req: Request):
             req.error = exc
-            self._slot_req[slot] = None
+            if req.emit:
+                try:
+                    req.emit(-1, True)
+                except Exception:  # noqa: BLE001 — a bad sink must not stop the sweep
+                    pass
             req.done.set()
+
+        for slot, req in list(self._active_requests()):
+            self._slot_req[slot] = None
+            finish(req)
         while True:
             try:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 break
-            req.error = exc
-            req.done.set()
+            finish(req)
 
     # --- engine core -------------------------------------------------------
 

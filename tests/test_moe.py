@@ -296,3 +296,25 @@ def test_hf_mixtral_checkpoint_roundtrip(tmp_path, tiny):
     got, _ = moe.forward(loaded, lcfg, tokens, positions)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_inference_capacity_never_drops_decode_tokens(tiny):
+    """Serving (cache-marked) capacity is exact for decode-sized batches:
+    under routing collapse the training drop policy zeroes overflow tokens'
+    expert compute, the inference policy must not (code-review r5)."""
+    cfg, params = tiny
+    tight = dataclasses.replace(cfg, capacity_factor=0.5)
+    w = {k: v[0] for k, v in params["layers"].items()}
+    # Collapse the router onto expert 0 for every token.
+    w = dict(w)
+    router = np.zeros((cfg.hidden_size, cfg.num_experts), np.float32)
+    router[:, 0] = 1.0
+    w["router"] = jnp.asarray(router)
+    h = jnp.ones((2, 8, cfg.hidden_size), jnp.float32)   # N=16 tokens
+
+    want = _naive_moe_block(h, w, tight)                 # no-drop reference
+    got_inf, _ = moe.moe_block(h, w, tight, inference=True)
+    np.testing.assert_allclose(np.asarray(got_inf), want, rtol=2e-4, atol=2e-4)
+
+    got_train, _ = moe.moe_block(h, w, tight)            # drops by design
+    assert not np.allclose(np.asarray(got_train), want, rtol=2e-4, atol=2e-4)

@@ -300,6 +300,9 @@ spec:
     assert all("token" in t for t in tok_records)
     assert final["done"] is True and final["numTokens"] == 4
     assert final["tokens"] == [t["token"] for t in tok_records]
+    # Prefix-diff contract: concatenated deltas == the final decode (BPE
+    # merging must not be broken by per-token decoding).
+    assert "".join(t["text"] for t in tok_records) == final["text"]
 
     d.kuke("delete", "cell", "llm", "--force")
     status = json.loads(d.kuke("--json", "status").stdout)
@@ -688,9 +691,17 @@ def test_doctor_tpu_runtime_probe(monkeypatch):
              if p and "axon" not in p]
     monkeypatch.setenv("PYTHONPATH", _os.pathsep.join(parts))
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("KUKEON_TPU_CHIPS", "")   # no chips claimed
     state, detail = probe_tpu_runtime(timeout_s=120.0)
     assert state == "ok", detail
     assert "backend=cpu" in detail
+
+    # Chips visible but the backend fell back to CPU (TPU init failed
+    # non-fatally): must NOT read as ok.
+    monkeypatch.setenv("KUKEON_TPU_CHIPS", "0,1")
+    state, detail = probe_tpu_runtime(timeout_s=120.0)
+    assert state == "unavailable"
+    assert "chips visible but backend=cpu" in detail
 
     # A wedged runtime = the child never returns: simulated with a child
     # that blocks forever (what a hung libtpu transfer looks like).
