@@ -68,6 +68,8 @@ def specs_for_params(params, fsdp: bool = False) -> dict:
 def _quant_scale_spec(spec: P, q, s) -> P:
     """Spec for an int8 scale vector: the matrix spec minus the contracted
     axis (scale spans the non-contracted axis/axes)."""
+    if q.ndim == 4:                      # experts [L, E, in, out] -> s [L, E, out]
+        return P(spec[0], spec[1], spec[3])
     if q.ndim == 3:                      # stacked [L, in, out] -> s [L, out]
         return P(spec[0], spec[2])
     # 2-D: s aligns with whichever matrix axis it matches in size.

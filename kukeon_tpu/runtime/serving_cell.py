@@ -146,11 +146,11 @@ class ServingCell:
         param_specs = None
         if model in MOE_MODELS:
             # MoE family: same engine, moe forward + expert-aware specs.
-            # int8 weights / int8-KV are llama-tree features the MoE path
-            # doesn't have yet — fail loudly rather than serving garbage.
-            if quantize or kv_cache_int8:
+            # int8-KV is a llama-decode-path feature the MoE forward doesn't
+            # have yet — fail loudly rather than serving garbage.
+            if kv_cache_int8:
                 raise SystemExit(
-                    f"model {model!r} does not support int8 serving yet"
+                    f"model {model!r} does not support --kv-cache-int8 yet"
                 )
             from kukeon_tpu.models import hf_convert, moe
             from kukeon_tpu.parallel import moe_specs_for_params
@@ -163,6 +163,10 @@ class ServingCell:
                     cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
             else:
                 params = moe.init_params(jax.random.key(seed), cfg)
+            if quantize:
+                # Weights-only int8 (router/norms stay high precision);
+                # dequant fuses into the attention _mm and expert einsums.
+                params = moe.quantize_params(params)
             forward_fn = moe.forward
             param_specs = moe_specs_for_params(params)
         elif checkpoint:

@@ -230,9 +230,9 @@ def test_moe_serving_cell_http_roundtrip():
     assert out["numTokens"] == 4
     assert len(out["tokens"]) == 4
 
-    with pytest.raises(SystemExit, match="int8"):
+    with pytest.raises(SystemExit, match="kv-cache-int8"):
         ServingCell("mixtral-tiny", num_slots=2, max_seq_len=64,
-                    checkpoint=None, dtype="int8")
+                    checkpoint=None, dtype=None, kv_cache_int8=True)
 
 
 def test_hf_mixtral_checkpoint_roundtrip(tmp_path, tiny):
@@ -318,3 +318,45 @@ def test_inference_capacity_never_drops_decode_tokens(tiny):
 
     got_train, _ = moe.moe_block(h, w, tight)            # drops by design
     assert not np.allclose(np.asarray(got_train), want, rtol=2e-4, atol=2e-4)
+
+
+def test_quantized_moe_forward_tracks_fp(tiny):
+    """Weights-only int8 MoE: logits stay close to full-precision (per-
+    channel symmetric quantization noise only), and the quantized tree
+    serves through the engine on an expert-sharded mesh identically to a
+    single device."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kukeon_tpu.parallel import moe_specs_for_params
+    from kukeon_tpu.serving import SamplingParams, ServingEngine
+
+    cfg, params = tiny
+    qp = moe.quantize_params(params)
+    B, S = 1, 12
+    tokens = jax.random.randint(jax.random.key(11), (B, S), 0, cfg.vocab_size)
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :]
+    fp, _ = moe.forward(params, cfg, tokens, positions)
+    q, _ = moe.forward(qp, cfg, tokens, positions)
+    err = np.abs(np.asarray(q) - np.asarray(fp)).mean()
+    scale = np.abs(np.asarray(fp)).mean() + 1e-9
+    assert err / scale < 0.05, f"relative error {err/scale:.3f}"
+
+    specs = moe_specs_for_params(qp)
+    mesh2 = make_mesh(expert=2, tensor=2, data=2)
+    eng2 = ServingEngine(cfg, qp, mesh2, num_slots=2, max_seq_len=64,
+                         forward_fn=moe.forward, param_specs=specs)
+    mesh1 = make_mesh(tensor=1, devices=jax.devices()[:1])
+    eng1 = ServingEngine(cfg, qp, mesh1, num_slots=2, max_seq_len=64,
+                         forward_fn=moe.forward, param_specs=specs)
+    prompt = np.arange(2, 12, dtype=np.int32) % cfg.vocab_size
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    assert eng2.generate(prompt, sp) == eng1.generate(prompt, sp)
+
+
+def test_quantized_moe_serving_cell():
+    from kukeon_tpu.runtime.serving_cell import ServingCell
+
+    cell = ServingCell("mixtral-tiny", num_slots=2, max_seq_len=64,
+                       checkpoint=None, dtype="int8")
+    out = cell.generate({"prompt": "hi", "maxNewTokens": 3})
+    assert out["numTokens"] == 3
