@@ -252,6 +252,56 @@ def moe_block(h: jnp.ndarray, w: dict, cfg: MoEConfig,
     return y.reshape(B, S, H), {"load_balance": lb, "router_z": z}
 
 
+def _decode_forward(
+    params: Params,
+    c: MoEConfig,
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    cache: KVCache,
+    B: int,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Single-token decode, HBM-optimal (mirrors llama._decode_forward: the
+    layer scan reads the cache as a read-only input and emits only the tiny
+    per-layer new K/V; the cache is updated once per step with per-slot
+    in-place slice writes — cache bytes stream through HBM exactly once).
+    The MoE block runs at N = B tokens, where dense dispatch is a few KB
+    and capacity is exact (no drops)."""
+    from kukeon_tpu.ops.attention import decode_gqa_attention
+
+    offsets = cache.lengths
+
+    def layer_step(x, layer):
+        w, ck, cv = layer
+        h = rms_norm(x, w["attn_norm"], c.rms_norm_eps)
+        q = _mm(h, w["wq"]).reshape(B, 1, c.num_heads, c.head_dim)
+        k = _mm(h, w["wk"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
+        v = _mm(h, w["wv"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
+        q = apply_rope(q, positions, c.rope_theta)
+        k = apply_rope(k, positions, c.rope_theta)
+
+        attn = decode_gqa_attention(q, k, v, ck, cv, offsets)
+        x = x + _mm(attn.reshape(B, 1, c.q_dim), w["wo"])
+
+        h = rms_norm(x, w["mlp_norm"], c.rms_norm_eps)
+        y, _ = moe_block(h, w, c, inference=True)
+        return x + y, (k, v)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        lambda carry, layer: layer_step(carry, (layer[0], layer[1], layer[2])),
+        x,
+        (params["layers"], cache.k, cache.v),
+    )
+    k_upd, v_upd = cache.k, cache.v
+    for b in range(B):
+        start = (0, b, offsets[b], 0, 0)
+        k_upd = jax.lax.dynamic_update_slice(k_upd, new_k[:, b : b + 1], start)
+        v_upd = jax.lax.dynamic_update_slice(v_upd, new_v[:, b : b + 1], start)
+    new_cache = KVCache(k=k_upd, v=v_upd, lengths=cache.lengths + 1)
+
+    x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    return llama._logits(params, c, x), new_cache
+
+
 def forward_with_aux(
     params: Params,
     cfg: MoEConfig,
@@ -273,6 +323,12 @@ def forward_with_aux(
     B, S = tokens.shape
     inference = cache is not None
     x = _embed(params, tokens, c.dtype)
+
+    if cache is not None and S == 1 and attn_impl in ("auto", "reference"):
+        logits, new_cache = _decode_forward(params, c, x, positions, cache, B)
+        return logits, new_cache, {"load_balance": jnp.float32(0.0),
+                                   "router_z": jnp.float32(0.0)}
+
     offsets = cache.lengths if cache is not None else None
 
     def layer_step(carry, layer):
