@@ -143,7 +143,7 @@ PROBE = (
     "import socket,sys\n"
     "def probe(ip, port):\n"
     "    s = socket.socket()\n"
-    "    s.settimeout(3)\n"
+    "    s.settimeout(10)\n"
     "    try:\n"
     "        s.connect((ip, port))\n"
     "        data = s.recv(64).decode()\n"
@@ -397,7 +397,7 @@ class TestUDPAndICMP:
         "import socket\n"
         "def probe(ip, port):\n"
         "    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)\n"
-        "    s.settimeout(3)\n"
+        "    s.settimeout(10)\n"
         "    try:\n"
         "        s.sendto(b'hi', (ip, port))\n"
         "        data, _ = s.recvfrom(128)\n"
@@ -487,7 +487,7 @@ spec:
         "def ping(ip):\n"
         "    s = socket.socket(socket.AF_INET, socket.SOCK_RAW,\n"
         "                      socket.IPPROTO_ICMP)\n"
-        "    s.settimeout(3)\n"
+        "    s.settimeout(10)\n"
         "    payload = struct.pack('!BBHHH', 8, 0, 0, os.getpid() & 0xFFFF, 1)\n"
         "    csum = 0\n"
         "    for i in range(0, len(payload), 2):\n"
@@ -529,7 +529,7 @@ spec:
       restartPolicy: {{policy: never}}
 """
             d.kuke("apply", "-f", "-", stdin_data=manifest)
-            deadline = time.monotonic() + 30
+            deadline = time.monotonic() + 60
             while time.monotonic() < deadline:
                 import json as _json
 
