@@ -69,18 +69,24 @@ def detect_backend() -> tuple[str, int]:
 
     A hung/unreachable TPU runtime (tunnel down, chip wedged) degrades to
     the CPU smoke instead of failing the whole benchmark: a measured CPU
-    line beats no line."""
+    line beats no line. The probe includes a real device transfer — a
+    wedged runtime initializes fine and then blocks the first device_put
+    forever (observed r4/r5), which would otherwise burn the entire
+    serve-phase timeout before the fallback could fire."""
 
     def probe() -> tuple[str, int] | str:
         try:
             out = subprocess.run(
                 [sys.executable, "-c",
-                 "import jax; print(jax.default_backend(), len(jax.devices()))"],
+                 "import jax, numpy; "
+                 "d = jax.device_put(numpy.ones((16, 1024, 1024), numpy.int8)); "
+                 "jax.block_until_ready(d); "
+                 "print(jax.default_backend(), len(jax.devices()))"],
                 capture_output=True, text=True, timeout=300, cwd=REPO,
                 env=subprocess_env(),
             )
         except subprocess.TimeoutExpired:
-            return "probe timed out (runtime unreachable)"
+            return "probe timed out (runtime unreachable or wedged)"
         if out.returncode != 0:
             return f"probe failed:\n{out.stderr[-1500:]}"
         backend, n = out.stdout.split()[-2:]
