@@ -258,6 +258,59 @@ def phase_embed(args) -> None:
     }), flush=True)
 
 
+def phase_ab(args) -> None:
+    """Perf-lever A/B sweep (VERDICT r4 item 4): decode-chunk {4,16,64} and
+    int8-KV on the flagship config, each arm in its own chip-owning
+    subprocess. Prints one JSON line with every arm's tok/s and appends it
+    to the TPU history. Run as `python bench.py --phase ab`."""
+    backend, n_chips = detect_backend()
+    _log(f"ab: backend={backend} n_chips={n_chips}")
+    qdir = None
+    if backend != "cpu":
+        qdir = ensure_quantized_8b()
+    arms = [
+        ("chunk4", ["--decode-chunk", "4"]),
+        ("chunk16", ["--decode-chunk", "16"]),
+        ("chunk64", ["--decode-chunk", "64"]),
+        ("chunk16+kvint8", ["--decode-chunk", "16", "--kv-int8"]),
+    ]
+    results: dict = {}
+    for name, extra in arms:
+        cmd = [sys.executable, os.path.abspath(__file__), "--phase", "serve"] + extra
+        if qdir:
+            cmd += ["--checkpoint", qdir]
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=2400, cwd=REPO, env=subprocess_env())
+        except subprocess.TimeoutExpired:
+            _log(f"ab arm {name}: timed out")
+            results[name] = None
+            continue
+        if out.returncode != 0:
+            _log(f"ab arm {name}: rc={out.returncode}\n{out.stderr[-1200:]}")
+            results[name] = None
+            continue
+        serve = json.loads(out.stdout.strip().splitlines()[-1])
+        results[name] = {"tok_per_s": round(serve["tok_per_s"], 2),
+                         "trials": serve["trials"]}
+        _log(f"ab arm {name}: {results[name]}")
+    line = {
+        "metric": f"decode-chunk/kv-int8 A/B, 8B int8, {n_chips} chip(s) [{backend}]",
+        "arms": results,
+        "backend": backend,
+    }
+    if backend == "tpu":
+        try:
+            with open(os.path.join(REPO, "BENCH_TPU_HISTORY.jsonl"), "a") as f:
+                f.write(json.dumps({
+                    "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    "note": "A/B sweep", **line,
+                }) + "\n")
+        except OSError:
+            pass
+    print(json.dumps(line))
+
+
 # --- cold-start phase ---------------------------------------------------------
 
 def _tail_file(path: str, limit: int = 2500) -> str:
@@ -399,7 +452,8 @@ def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--phase", default="all", choices=["all", "serve", "embed"])
+    ap.add_argument("--phase", default="all",
+                    choices=["all", "serve", "embed", "ab"])
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--decode-chunk", type=int,
                     default=int(os.environ.get("KUKEON_BENCH_CHUNK", "16")))
@@ -415,6 +469,9 @@ def main() -> None:
         return
     if args.phase == "embed":
         phase_embed(args)
+        return
+    if args.phase == "ab":
+        phase_ab(args)
         return
 
     backend, n_chips = detect_backend()
