@@ -11,3 +11,8 @@ from kukeon_tpu.training.checkpointing import (  # noqa: F401
     restore_checkpoint,
     save_checkpoint,
 )
+from kukeon_tpu.training.data import (  # noqa: F401
+    TokenDataset,
+    batches,
+    sample_batch,
+)

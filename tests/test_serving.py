@@ -219,3 +219,25 @@ def test_async_load_failure_surfaces(monkeypatch):
                         async_load=True)
     with pytest.raises(RuntimeError, match="weight load failed"):
         eng.generate(np.ones((4,), np.int32), SamplingParams(max_new_tokens=2))
+
+
+def test_fail_all_sends_terminal_emit_event():
+    """Engine-loop failure must deliver the (-1, True) terminal event to
+    emit-channel consumers — a streaming client blocks on its queue, not on
+    req.done (code-review r5: it would hang forever otherwise)."""
+    import queue as q
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+    eng = ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=64)
+
+    events: q.Queue = q.Queue()
+    r = eng.submit(np.arange(1, 9, dtype=np.int32),
+                   SamplingParams(max_new_tokens=4),
+                   emit=lambda tok, done: events.put((tok, done)))
+    eng._fail_all(RuntimeError("device lost"))
+    tok, done = events.get(timeout=5)
+    assert (tok, done) == (-1, True)
+    assert r.done.is_set()
+    assert isinstance(r.error, RuntimeError)
