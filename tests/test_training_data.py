@@ -99,3 +99,33 @@ def test_train_loop_with_resume_on_real_data(dataset, tmp_path):
         _, l23_resumed = run(2, restored, 2, step_fn2, bsh2)
 
     assert l23_resumed == l23_cont
+
+
+def test_training_cli_end_to_end(dataset, tmp_path):
+    """`python -m kukeon_tpu.training.cli`: train, checkpoint, resume —
+    black-box over a subprocess (the operator's actual entrypoint)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo
+    env["JAX_PLATFORMS"] = "cpu"
+    ck = str(tmp_path / "ck")
+    base = [sys.executable, "-m", "kukeon_tpu.training.cli",
+            "--dataset", dataset.path, "--model", "tiny",
+            "--batch", "4", "--seq-len", "32", "--log-every", "2",
+            "--ckpt-dir", ck]
+
+    p = subprocess.run(base + ["--steps", "4", "--save-every", "2"],
+                       capture_output=True, text=True, timeout=600, env=env)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "step 4 loss" in p.stdout
+    assert "checkpoint at step 4" in p.stdout
+
+    p2 = subprocess.run(base + ["--steps", "6"],
+                        capture_output=True, text=True, timeout=600, env=env)
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    assert "resumed from step 4" in p2.stdout
+    assert "step 6 loss" in p2.stdout
