@@ -45,11 +45,16 @@ def latest_step(root: str) -> int | None:
 
 
 def save_checkpoint(root: str, state: TrainState) -> str:
-    """Write ``state`` as ``<root>/step_<state.step>``; returns the path."""
+    """Write ``state`` as ``<root>/step_<state.step>``; returns the path.
+    Idempotent per step: a completed checkpoint for this exact step is
+    left as-is (a save-every boundary coinciding with the final save must
+    not error)."""
     import orbax.checkpoint as ocp
 
     step = int(state.step)
     path = _step_dir(root, step)
+    if os.path.isdir(path):
+        return path
     os.makedirs(root, exist_ok=True)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, state)
