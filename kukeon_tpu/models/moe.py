@@ -166,6 +166,46 @@ def quantize_params(params: Params) -> Params:
     return out
 
 
+def init_quantized_params_host(cfg: MoEConfig, seed: int = 0) -> Params:
+    """Random-init DIRECTLY in int8 on the host, leaf by leaf (mirrors
+    llama.init_quantized_params_host: a mixtral-8x7b bf16 tree is ~93 GB —
+    it cannot be materialized on a 16 GB chip just to be quantized)."""
+    import numpy as np
+
+    from kukeon_tpu.models.llama import quantize_np
+
+    c = cfg
+    rng = np.random.default_rng(seed)
+    L, H, I, V, E = (c.num_layers, c.hidden_size, c.intermediate_size,
+                     c.vocab_size, c.num_experts)
+    ndtype = np.dtype(c.dtype)
+
+    def q(shape, fan_in, axis):
+        w = rng.standard_normal(shape, np.float32) * (fan_in ** -0.5)
+        return quantize_np(w, axis)
+
+    params: Params = {
+        "embed": q((V, H), H, 1),
+        "layers": {
+            "attn_norm": np.ones((L, H), ndtype),
+            "wq": q((L, H, c.q_dim), H, 1),
+            "wk": q((L, H, c.kv_dim), H, 1),
+            "wv": q((L, H, c.kv_dim), H, 1),
+            "wo": q((L, c.q_dim, H), c.q_dim, 1),
+            "mlp_norm": np.ones((L, H), ndtype),
+            "router": (rng.standard_normal((L, H, E), np.float32)
+                       * (H ** -0.5)),
+            "w_gate": q((L, E, H, I), H, 2),
+            "w_up": q((L, E, H, I), H, 2),
+            "w_down": q((L, E, I, H), I, 2),
+        },
+        "final_norm": np.ones((H,), ndtype),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = q((H, V), H, 0)
+    return params
+
+
 def _expert_mm(x: jnp.ndarray, w, eq: str) -> jnp.ndarray:
     """Per-expert batched matmul ('ech,ehi->eci' or 'eci,eih->ech') for
     plain or int8 ({"q","s"}) expert stacks; dequant fuses into the dot."""

@@ -161,12 +161,17 @@ class ServingCell:
                 )
                 if max_seq_len:
                     cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
+                if quantize:
+                    # Weights-only int8 (router/norms stay high precision);
+                    # dequant fuses into attention _mm and expert einsums.
+                    params = moe.quantize_params(params)
+            elif quantize:
+                # Random-init directly in int8 on the host: a mixtral-8x7b
+                # bf16 tree (~93 GB) cannot be materialized on-device just
+                # to be quantized (same rule as the Llama path).
+                params = moe.init_quantized_params_host(cfg, seed)
             else:
                 params = moe.init_params(jax.random.key(seed), cfg)
-            if quantize:
-                # Weights-only int8 (router/norms stay high precision);
-                # dequant fuses into the attention _mm and expert einsums.
-                params = moe.quantize_params(params)
             forward_fn = moe.forward
             param_specs = moe_specs_for_params(params)
         elif checkpoint:

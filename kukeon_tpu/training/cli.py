@@ -123,11 +123,19 @@ def main(argv=None) -> int:
 
         start = 0
         if args.ckpt_dir and latest_step(args.ckpt_dir) is not None:
-            state = restore_checkpoint(args.ckpt_dir, state)
+            from kukeon_tpu.training import abstract_like
+
+            # Free the throwaway init BEFORE the restore reads the
+            # checkpoint copy in — otherwise peak HBM is 2x the model
+            # state and an 8B resume OOMs where from-scratch trains fine.
+            template = abstract_like(state)
+            state = None
+            state = restore_checkpoint(args.ckpt_dir, template)
             start = int(state.step)
             print(f"train: resumed from step {start}", flush=True)
 
         t0 = time.monotonic()
+        last_logged = start
         for step, tok, tgt, mask in batches(
             ds, args.batch, args.seq_len, start_step=start,
             num_steps=args.steps - start, seed=args.seed,
@@ -137,13 +145,15 @@ def main(argv=None) -> int:
             loss = out["loss"] if isinstance(out, dict) else out
             if (step + 1) % args.log_every == 0 or step + 1 == args.steps:
                 dt = time.monotonic() - t0
-                tput = args.batch * args.seq_len * args.log_every / max(dt, 1e-9)
+                window = step + 1 - last_logged   # may be < log_every at the tail
+                tput = args.batch * args.seq_len * window / max(dt, 1e-9)
                 extra = ""
                 if isinstance(out, dict):
                     extra = f" lb={float(out['load_balance']):.3f}"
                 print(f"step {step + 1} loss {float(loss):.4f}{extra} "
                       f"({tput:.0f} tok/s)", flush=True)
                 t0 = time.monotonic()
+                last_logged = step + 1
             if (args.ckpt_dir and args.save_every
                     and (step + 1) % args.save_every == 0):
                 save_checkpoint(args.ckpt_dir, state)

@@ -64,7 +64,9 @@ def sample_batch(ds: TokenDataset, step: int, batch_size: int, seq_len: int,
             f"dataset {ds.path} has {n} tokens < seq_len+1 ({seq_len + 1})"
         )
     rng = np.random.default_rng([seed, step])
-    offsets = rng.integers(0, n - seq_len - 1, size=batch_size)
+    # Exclusive high: the last valid window starts at n - seq_len - 1
+    # (targets slice reaches o + seq_len + 1 == n).
+    offsets = rng.integers(0, n - seq_len, size=batch_size)
     tokens = np.stack([np.asarray(ds.tokens[o:o + seq_len]) for o in offsets])
     targets = np.stack(
         [np.asarray(ds.tokens[o + 1:o + seq_len + 1]) for o in offsets]

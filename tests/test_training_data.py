@@ -54,7 +54,7 @@ def test_targets_shifted_by_one(dataset):
     # target[i] is the next token of tokens[i] in the source stream: check
     # via the underlying memmap (offsets are deterministic for the seed).
     rng = np.random.default_rng([1, 0])
-    offs = rng.integers(0, len(dataset) - 33, size=2)
+    offs = rng.integers(0, len(dataset) - 32, size=2)
     np.testing.assert_array_equal(
         targets[0], np.asarray(dataset.tokens[offs[0] + 1:offs[0] + 33]))
     assert mask.all()
