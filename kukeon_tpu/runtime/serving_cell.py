@@ -252,53 +252,72 @@ class ServingCell:
             prompt = np.asarray(self.tokenizer.encode(req["prompt"]), np.int32)
         else:
             raise ValueError("need promptTokens or prompt")
+        stops = req.get("stop", [])
+        if isinstance(stops, str):
+            stops = [stops]
+        if not all(isinstance(s, str) and s for s in stops):
+            raise ValueError("stop must be a non-empty string or list of them")
         sp = SamplingParams(
             temperature=float(req.get("temperature", 0.0)),
             top_k=int(req.get("topK", 0)),
             top_p=float(req.get("topP", 1.0)),
             max_new_tokens=int(req.get("maxNewTokens", 128)),
+            stop_tokens=tuple(int(t) for t in req.get("stopTokens", [])),
         )
-        return prompt, sp
+        return prompt, sp, list(stops)
 
     def generate(self, req: dict) -> dict:
-        prompt, sp = self._parse_generate(req)
-        t0 = time.monotonic()
-        tokens = self.engine.generate(prompt, sp)
-        dt = time.monotonic() - t0
-        with self._stats_lock:
-            self.total_tokens += len(tokens)
-        return {
-            "tokens": tokens,
-            "text": self.tokenizer.decode(tokens),
-            "numTokens": len(tokens),
-            "seconds": round(dt, 4),
-        }
+        """Non-streaming generation: the terminal record of the streaming
+        path (one machinery for both modes — stop handling included)."""
+        out = None
+        for out in self.generate_stream(req):
+            pass
+        if "error" in out:
+            raise RuntimeError(out["error"])
+        return {k: out[k] for k in ("tokens", "text", "numTokens", "seconds")}
 
     def generate_stream(self, req: dict):
-        """Streaming generation: yields one JSON-line dict per token batch
-        as the engine emits them (an agent session reads tokens as they
-        decode instead of waiting for the full completion), then a terminal
-        record with the aggregate fields of :meth:`generate`."""
+        """Streaming generation: yields one JSON-line dict per token as the
+        engine emits them (an agent session reads tokens as they decode
+        instead of waiting for the full completion), then a terminal record
+        with the aggregate fields.
+
+        ``stop`` strings are matched against the accumulated decode; on a
+        match the request is cancelled (the slot frees immediately) and the
+        emitted text is cut at the match. ``stopTokens`` stop token-exactly
+        inside the engine."""
         import queue as _q
 
-        prompt, sp = self._parse_generate(req)
+        prompt, sp, stops = self._parse_generate(req)
         events: _q.Queue = _q.Queue()
         t0 = time.monotonic()
         r = self.engine.submit(prompt, sp,
                                emit=lambda tok, done: events.put((tok, done)))
+        driving = not self.engine._running   # direct use without the thread
         tokens: list[int] = []
         emitted = ""
+        stopped = False
         while True:
+            if driving:
+                while events.empty() and not r.done.is_set():
+                    self.engine.step()
             tok, done = events.get()
-            if tok >= 0:
+            if tok >= 0 and not stopped:
                 tokens.append(tok)
                 # Incremental decode by prefix diff: decoding ids in
                 # isolation breaks BPE merging (word-boundary markers,
                 # multi-token UTF-8), so concatenated per-token text would
                 # not equal the final decode.
                 full = self.tokenizer.decode(tokens)
+                hit = min((full.find(s) for s in stops if s in full),
+                          default=-1)
+                if hit >= 0:
+                    full = full[:hit]
+                    stopped = True
+                    r.cancel()
                 delta, emitted = full[len(emitted):], full
-                yield {"token": tok, "text": delta}
+                if delta or not stopped:
+                    yield {"token": tok, "text": delta}
             if done:
                 break
         if r.error is not None:
@@ -310,10 +329,11 @@ class ServingCell:
         yield {
             "done": True,
             "tokens": tokens,
-            "text": self.tokenizer.decode(tokens),
+            "text": emitted if stops else self.tokenizer.decode(tokens),
             "numTokens": len(tokens),
             "seconds": round(dt, 4),
-            "cancelled": bool(r.cancelled),
+            "cancelled": bool(r.cancelled) and not stopped,
+            "stopped": stopped,
         }
 
     def stats(self) -> dict:

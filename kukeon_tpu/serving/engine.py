@@ -761,6 +761,7 @@ class ServingEngine:
         req.generated.append(token)
         finished = (
             token in self.eos_ids
+            or token in req.sampling.stop_tokens
             or len(req.generated) >= req.sampling.max_new_tokens
             or self._slot_len[req.slot] >= self.max_seq_len
         )

@@ -14,6 +14,10 @@ class SamplingParams:
     top_k: int = 0             # 0 => disabled
     top_p: float = 1.0         # 1 => disabled
     max_new_tokens: int = 256
+    # Per-request stop tokens (host-side check in the engine's emit path —
+    # the slot frees the moment one is generated; the stop token itself is
+    # included in the output, clients strip it if unwanted).
+    stop_tokens: tuple[int, ...] = ()
 
 
 def sample(logits: jnp.ndarray, key: jax.Array, params: SamplingParams) -> jnp.ndarray:

@@ -138,3 +138,46 @@ def test_metadata_store(tmp_path):
     assert store.read_json("realms", "default", "realm.json")["a"] == 2
     assert store.delete("realms", "default", "realm.json")
     assert not store.delete("realms", "default", "realm.json")
+
+
+def test_serving_cell_stop_strings():
+    """`stop` strings cut generation (and text) at the first match in both
+    modes; `stopTokens` stop token-exactly."""
+    import numpy as np
+
+    from kukeon_tpu.runtime.serving_cell import ServingCell
+
+    cell = ServingCell("tiny", num_slots=2, max_seq_len=64,
+                       checkpoint=None, dtype=None)
+    base = cell.generate({"prompt": "hello", "maxNewTokens": 6})
+    assert base["numTokens"] == 6
+
+    # Token-level stop: replay greedy and stop at the 2nd generated token.
+    stop_tok = base["tokens"][1]
+    out = cell.generate({"prompt": "hello", "maxNewTokens": 6,
+                         "stopTokens": [int(stop_tok)]})
+    assert out["tokens"] == base["tokens"][:2]
+
+    # String-level stop: pick a substring of the full decode that first
+    # appears at a known offset; text must be cut before it.
+    full = base["text"]
+    if len(full) >= 2:
+        stop_s = full[1:2]
+        out = cell.generate({"prompt": "hello", "maxNewTokens": 6,
+                             "stop": stop_s})
+        assert stop_s not in out["text"]
+        assert full.startswith(out["text"])
+
+    # Streaming mode agrees: terminal record marks stopped and the joined
+    # deltas equal the final text.
+    recs = list(cell.generate_stream({"prompt": "hello", "maxNewTokens": 6,
+                                      "stop": [full[1:2]] if len(full) >= 2
+                                      else ["zzz"]}))
+    final = recs[-1]
+    assert "".join(r["text"] for r in recs[:-1]) == final["text"]
+
+    # Validation: bad stop type is a clean 400-class error.
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="stop"):
+        cell.generate({"prompt": "x", "stop": [42]})

@@ -241,3 +241,21 @@ def test_fail_all_sends_terminal_emit_event():
     assert (tok, done) == (-1, True)
     assert r.done.is_set()
     assert isinstance(r.error, RuntimeError)
+
+
+def test_per_request_stop_tokens():
+    """A request's stop_tokens end ITS generation early (slot frees) while
+    other requests keep their own budgets."""
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+    eng = ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=64)
+    prompt = np.arange(1, 9, dtype=np.int32)
+
+    free = eng.generate(prompt, SamplingParams(temperature=0.0, max_new_tokens=8))
+    assert len(free) == 8
+    stop_at = free[2]
+    stopped = eng.generate(
+        prompt, SamplingParams(temperature=0.0, max_new_tokens=8,
+                               stop_tokens=(int(stop_at),)))
+    assert stopped == free[:3]          # stop token included, then ends
