@@ -193,17 +193,24 @@ def gqa_attention(
     q: [B, Sq, NH, D]; k, v: [B, Skv, NKV, D] with NH % NKV == 0.
 
     ``impl``: "auto" picks flash on TPU for long-enough sequences, else the
-    XLA reference; "reference" / "flash" / "ring" force a path. "ring" is the
-    sequence-parallel path (shard_map + ppermute over the ``seq`` mesh axis)
-    and requires an ambient mesh (``jax.set_mesh``) with a ``seq`` axis.
+    XLA reference; "reference" / "flash" / "ring" / "ulysses" force a path.
+    "ring" (shard_map + ppermute) and "ulysses" (all-to-all seq<->heads) are
+    the sequence-parallel paths over the ``seq`` mesh axis and require an
+    ambient mesh (``jax.set_mesh``) with one.
     """
-    if impl == "ring":
+    if impl in ("ring", "ulysses"):
         if kv_length is not None or q.shape[1] != k.shape[1]:
             raise ValueError(
-                "impl='ring' requires full self-attention (Sq == Skv, no "
+                f"impl={impl!r} requires full self-attention (Sq == Skv, no "
                 f"kv_length); got Sq={q.shape[1]}, Skv={k.shape[1]}, "
                 f"kv_length={'set' if kv_length is not None else 'None'}. "
                 "Use 'reference' or 'auto' for cached decode."
+            )
+        if impl == "ulysses":
+            from kukeon_tpu.parallel.ulysses import ulysses_attention
+
+            return ulysses_attention(
+                q, k, v, q_positions=q_positions, kv_positions=kv_positions
             )
         from kukeon_tpu.parallel.ring_attention import ring_attention
 

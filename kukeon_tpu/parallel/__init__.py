@@ -17,6 +17,7 @@ from kukeon_tpu.parallel.pipeline import (  # noqa: F401
     pp_specs_for_params,
 )
 from kukeon_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from kukeon_tpu.parallel.ulysses import ulysses_attention  # noqa: F401
 from kukeon_tpu.parallel.sharding import (  # noqa: F401
     batch_spec,
     kv_cache_spec,
