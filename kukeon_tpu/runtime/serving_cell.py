@@ -264,7 +264,10 @@ class ServingCell:
             max_new_tokens=int(req.get("maxNewTokens", 128)),
             stop_tokens=tuple(int(t) for t in req.get("stopTokens", [])),
         )
-        return prompt, sp, list(stops)
+        prefix_id = req.get("prefixId")
+        if prefix_id is not None and not isinstance(prefix_id, str):
+            raise ValueError("prefixId must be a string")
+        return prompt, sp, list(stops), prefix_id
 
     def generate(self, req: dict) -> dict:
         """Non-streaming generation: the terminal record of the streaming
@@ -288,11 +291,12 @@ class ServingCell:
         inside the engine."""
         import queue as _q
 
-        prompt, sp, stops = self._parse_generate(req)
+        prompt, sp, stops, prefix_id = self._parse_generate(req)
         events: _q.Queue = _q.Queue()
         t0 = time.monotonic()
         r = self.engine.submit(prompt, sp,
-                               emit=lambda tok, done: events.put((tok, done)))
+                               emit=lambda tok, done: events.put((tok, done)),
+                               prefix_id=prefix_id)
         driving = not self.engine._running   # direct use without the thread
         tokens: list[int] = []
         emitted = ""
@@ -346,6 +350,9 @@ class ServingCell:
             "freeSlots": len(self.engine._free_slots()),
             "uptimeSeconds": round(time.time() - self.started_at, 1),
             "totalTokens": self.total_tokens,
+            "prefixCache": {"hits": self.engine.prefix_hits,
+                            "misses": self.engine.prefix_misses,
+                            "entries": len(self.engine._prefix_cache)},
         }
 
 

@@ -79,6 +79,10 @@ class Request:
     first_token_at: float = 0.0
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     cancelled: bool = False
+    # Prefix-cache participation (agent sessions share a system prompt /
+    # growing conversation): requests with the same prefix_id reuse the
+    # stored prompt KV and prefill only the new suffix.
+    prefix_id: str | None = None
 
     def cancel(self) -> None:
         """Ask the engine to stop generating for this request. Thread-safe:
@@ -87,6 +91,16 @@ class Request:
         a still-queued one without waiting for a slot — so engine state is
         never touched off-thread. Waiters wake via ``done``."""
         self.cancelled = True
+
+
+@dataclasses.dataclass
+class _CachedPrefix:
+    """Stored prompt KV for one prefix_id (device arrays)."""
+
+    tokens: tuple[int, ...]          # the exact prompt this KV encodes
+    kv_k: Any                        # [L, 1, Pb, KV, D] (bucketed length)
+    kv_v: Any
+    length: int                      # valid positions in the block
 
 
 @dataclasses.dataclass
@@ -132,6 +146,7 @@ class ServingEngine:
         async_load: bool = False,
         forward_fn=None,
         param_specs=None,
+        prefix_cache_size: int = 8,
     ):
         # Model pluggability: any forward with llama.forward's signature
         # ((params, cfg, tokens, positions, cache) -> (logits, cache')) and
@@ -233,6 +248,17 @@ class ServingEngine:
         self._thread: threading.Thread | None = None
         self.error: Exception | None = None   # last engine-loop failure
 
+        # Prefix cache: prefix_id -> stored prompt KV (LRU, driver-thread
+        # only). Agent sessions re-send a large shared/growing context with
+        # every request; reusing its KV turns an O(context) prefill into an
+        # O(new tokens) one.
+        from collections import OrderedDict
+
+        self._prefix_cache: "OrderedDict[str, _CachedPrefix]" = OrderedDict()
+        self._prefix_cache_size = max(0, prefix_cache_size)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+
         self._build_programs()
 
     # --- jitted programs ---------------------------------------------------
@@ -279,6 +305,31 @@ class ServingEngine:
             S = tokens.shape[1]
             positions = jnp.arange(S, dtype=jnp.int32)[None, :]
             cache = llama.KVCache.create(cfg, 1, S)
+            logits, cache = fwd(params, cfg, tokens, positions, cache)
+            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, keepdims=False)
+            first = sample_per_slot(
+                last[None, :], key, temp[None], top_k[None], top_p[None]
+            )[0]
+            return first, cache.k, cache.v
+
+        def prefill_ext(params, kv_k, kv_v, plen, tokens, length, key,
+                        temp, top_k, top_p):
+            """Prefill a suffix against a pre-seeded prefix KV block.
+
+            kv_k/kv_v: [L, 1, Pb, KV, D] stored prefix (Pb bucketed, first
+            ``plen`` rows valid); tokens: [1, S_tail] at positions
+            plen..plen+S_tail-1. The tail's K/V overwrite rows starting at
+            plen; rows past plen+length are masked by kv_length. Returns
+            (first sampled token, full kv block [L, 1, Pb+S_tail, ...])."""
+            S = tokens.shape[1]
+            Pb = kv_k.shape[2]
+            base = llama.KVCache.create(cfg, 1, Pb + S)
+            cache = llama.KVCache(
+                k=jax.lax.dynamic_update_slice(base.k, kv_k, (0, 0, 0, 0, 0)),
+                v=jax.lax.dynamic_update_slice(base.v, kv_v, (0, 0, 0, 0, 0)),
+                lengths=jnp.full((1,), plen, jnp.int32),
+            )
+            positions = plen + jnp.arange(S, dtype=jnp.int32)[None, :]
             logits, cache = fwd(params, cfg, tokens, positions, cache)
             last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, keepdims=False)
             first = sample_per_slot(
@@ -345,6 +396,7 @@ class ServingEngine:
             return state, toks.T  # [B, K]
 
         self._prefill = jax.jit(prefill)
+        self._prefill_ext = jax.jit(prefill_ext)
         self._insert = jax.jit(insert, donate_argnums=(0,))
         self._decode_chunk = jax.jit(
             decode_chunk_fn, static_argnums=(6,), donate_argnums=(1,)
@@ -436,6 +488,7 @@ class ServingEngine:
         prompt: np.ndarray | list[int],
         sampling: SamplingParams | None = None,
         emit: Callable[[int, bool], None] | None = None,
+        prefix_id: str | None = None,
     ) -> Request:
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
@@ -449,6 +502,7 @@ class ServingEngine:
                 id=self._next_id, prompt=prompt,
                 sampling=sampling or SamplingParams(),
                 emit=emit, submitted_at=time.monotonic(),
+                prefix_id=prefix_id,
             )
             self._next_id += 1
             self._requests[req.id] = req
@@ -658,20 +712,76 @@ class ServingEngine:
         self._inflight = new_inflight
         return did_work
 
+    def _prefix_lookup(self, req: Request) -> "_CachedPrefix | None":
+        """Stored prefix usable for this request: its tokens must be a
+        strict prefix of the prompt (equal would leave nothing to prefill,
+        and the stored block carries no logits)."""
+        if req.prefix_id is None:
+            return None
+        e = self._prefix_cache.get(req.prefix_id)
+        if (
+            e is not None
+            and req.prompt.size > e.length
+            and tuple(int(t) for t in req.prompt[: e.length]) == e.tokens
+        ):
+            self._prefix_cache.move_to_end(req.prefix_id)
+            return e
+        return None
+
+    def _prefix_store(self, prefix_id: str, prompt: np.ndarray,
+                      kv_k, kv_v) -> None:
+        self._prefix_cache[prefix_id] = _CachedPrefix(
+            tokens=tuple(int(t) for t in prompt),
+            kv_k=kv_k, kv_v=kv_v, length=int(prompt.size),
+        )
+        self._prefix_cache.move_to_end(prefix_id)
+        while len(self._prefix_cache) > self._prefix_cache_size:
+            self._prefix_cache.popitem(last=False)
+
     def _dispatch_prefill(self, req: Request, slot: int):
         """Queue prefill+insert on device; returns (req, first-token device
-        value) to fetch after other dispatches."""
+        value) to fetch after other dispatches.
+
+        With a prefix-cache hit, only the prompt's new suffix runs through
+        the model (an agent session's shared context prefills once); the
+        resulting prompt KV is (re)stored under the request's prefix_id
+        either way."""
         n = req.prompt.size
-        bucket = min(bucket_length(n), self.max_seq_len)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = req.prompt
         sp = req.sampling
+        cached = self._prefix_lookup(req)
         with jax.set_mesh(self.mesh):
             self._key, k1 = jax.random.split(self._key)
-            first, kv_k, kv_v = self._prefill(
-                self.params, jnp.asarray(tokens), n, k1,
-                jnp.float32(sp.temperature), jnp.int32(sp.top_k), jnp.float32(sp.top_p),
-            )
+            if cached is not None:
+                self.prefix_hits += 1
+                tail = req.prompt[cached.length:]
+                bucket = bucket_length(tail.size)
+                tokens = np.zeros((1, bucket), np.int32)
+                tokens[0, : tail.size] = tail
+                first, kv_k, kv_v = self._prefill_ext(
+                    self.params, cached.kv_k, cached.kv_v, cached.length,
+                    jnp.asarray(tokens), tail.size, k1,
+                    jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                    jnp.float32(sp.top_p),
+                )
+                if kv_k.shape[2] > self.max_seq_len:
+                    # Bucket padding (prefix bucket + tail bucket) can
+                    # exceed a slot; the valid n rows always fit — the
+                    # excess is padding by construction (n < max_seq_len).
+                    kv_k = kv_k[:, :, : self.max_seq_len]
+                    kv_v = kv_v[:, :, : self.max_seq_len]
+            else:
+                if req.prefix_id is not None:
+                    self.prefix_misses += 1
+                bucket = min(bucket_length(n), self.max_seq_len)
+                tokens = np.zeros((1, bucket), np.int32)
+                tokens[0, :n] = req.prompt
+                first, kv_k, kv_v = self._prefill(
+                    self.params, jnp.asarray(tokens), n, k1,
+                    jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                    jnp.float32(sp.top_p),
+                )
+            if req.prefix_id is not None:
+                self._prefix_store(req.prefix_id, req.prompt, kv_k, kv_v)
             self.state = self._insert(self.state, kv_k, kv_v, n, slot, first)
         req.slot = slot
         req.first_token_at = time.monotonic()

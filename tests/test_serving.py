@@ -259,3 +259,78 @@ def test_per_request_stop_tokens():
         prompt, SamplingParams(temperature=0.0, max_new_tokens=8,
                                stop_tokens=(int(stop_at),)))
     assert stopped == free[:3]          # stop token included, then ends
+
+
+class TestPrefixCache:
+    """Prefix caching: agent sessions reuse their shared context's KV."""
+
+    def _eng(self, **kw):
+        cfg = llama.llama_tiny()
+        params = llama.init_params(jax.random.key(0), cfg)
+        mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+        return ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=128,
+                             **kw), cfg, params
+
+    def test_hit_matches_uncached_output_exactly(self):
+        """Suffix-only prefill over the stored prefix KV must produce the
+        SAME greedy continuation as a full prefill of the whole prompt."""
+        eng, cfg, params = self._eng()
+        system = np.arange(1, 70, dtype=np.int32) % cfg.vocab_size  # 69 toks
+        turn1 = np.concatenate([system, np.array([7, 8, 9], np.int32)])
+        sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+
+        want = eng.generate(turn1, sp)                      # no prefix id
+        r = eng.submit(system, sp, prefix_id="sess")        # seeds the cache
+        while not r.done.is_set():
+            eng.step()
+        assert eng.prefix_misses == 1
+
+        r = eng.submit(turn1, sp, prefix_id="sess")
+        while not r.done.is_set():
+            eng.step()
+        assert eng.prefix_hits == 1
+        assert r.generated == want
+
+    def test_growing_conversation_rolls_forward(self):
+        """Each turn re-stores the full prompt KV, so turn N+1 hits on turn
+        N's whole context (system + conversation so far)."""
+        eng, cfg, _ = self._eng()
+        sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+        prompt = np.arange(1, 40, dtype=np.int32) % cfg.vocab_size
+        for turn in range(3):
+            r = eng.submit(prompt, sp, prefix_id="chat")
+            while not r.done.is_set():
+                eng.step()
+            prompt = np.concatenate(
+                [prompt, np.asarray(r.generated, np.int32),
+                 np.array([11 + turn], np.int32)])
+        assert eng.prefix_misses == 1      # only the first turn
+        assert eng.prefix_hits == 2
+
+    def test_mismatched_prefix_is_a_miss_and_restores(self):
+        eng, cfg, _ = self._eng()
+        sp = SamplingParams(temperature=0.0, max_new_tokens=2)
+        a = np.arange(1, 30, dtype=np.int32)
+        b = np.arange(2, 40, dtype=np.int32)    # NOT an extension of a
+        for p in (a, b):
+            r = eng.submit(p, sp, prefix_id="s")
+            while not r.done.is_set():
+                eng.step()
+        assert eng.prefix_hits == 0
+        assert eng.prefix_misses == 2
+        # But b is now the stored prefix: extending it hits.
+        r = eng.submit(np.concatenate([b, np.array([5], np.int32)]), sp,
+                       prefix_id="s")
+        while not r.done.is_set():
+            eng.step()
+        assert eng.prefix_hits == 1
+
+    def test_lru_eviction(self):
+        eng, cfg, _ = self._eng(prefix_cache_size=2)
+        sp = SamplingParams(temperature=0.0, max_new_tokens=1)
+        for name in ("a", "b", "c"):
+            r = eng.submit(np.arange(1, 20, dtype=np.int32), sp,
+                           prefix_id=name)
+            while not r.done.is_set():
+                eng.step()
+        assert set(eng._prefix_cache) == {"b", "c"}
