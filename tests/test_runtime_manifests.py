@@ -181,3 +181,23 @@ def test_serving_cell_stop_strings():
 
     with _pytest.raises(ValueError, match="stop"):
         cell.generate({"prompt": "x", "stop": [42]})
+
+
+def test_serving_cell_prefix_id_passthrough():
+    """`prefixId` flows from the HTTP request shape through to the engine's
+    prefix cache (hit visible in /v1/stats)."""
+    from kukeon_tpu.runtime.serving_cell import ServingCell
+
+    cell = ServingCell("tiny", num_slots=2, max_seq_len=64,
+                       checkpoint=None, dtype=None)
+    cell.generate({"prompt": "system prompt", "maxNewTokens": 2,
+                   "prefixId": "sess"})
+    cell.generate({"prompt": "system prompt and more", "maxNewTokens": 2,
+                   "prefixId": "sess"})
+    pc = cell.stats()["prefixCache"]
+    assert pc == {"hits": 1, "misses": 1, "entries": 1}
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="prefixId"):
+        cell.generate({"prompt": "x", "prefixId": 42})
