@@ -335,7 +335,15 @@ class ServingEngine:
             first = sample_per_slot(
                 last[None, :], key, temp[None], top_k[None], top_p[None]
             )[0]
-            return first, cache.k, cache.v
+            # Clamp to slot size INSIDE the program: the excess rows are
+            # bucket padding by construction (prompt < max_seq_len), and an
+            # eager slice on a GSPMD-sharded output can hit unparseable
+            # named-sharding conversions.
+            out_k, out_v = cache.k, cache.v
+            if Pb + S > self.max_seq_len:
+                out_k = out_k[:, :, : self.max_seq_len]
+                out_v = out_v[:, :, : self.max_seq_len]
+            return first, out_k, out_v
 
         def insert(state: DecodeState, kv_k, kv_v, length, slot, token):
             """Copy a prefill's KV block into ``slot`` and activate it.
@@ -763,12 +771,6 @@ class ServingEngine:
                     jnp.float32(sp.temperature), jnp.int32(sp.top_k),
                     jnp.float32(sp.top_p),
                 )
-                if kv_k.shape[2] > self.max_seq_len:
-                    # Bucket padding (prefix bucket + tail bucket) can
-                    # exceed a slot; the valid n rows always fit — the
-                    # excess is padding by construction (n < max_seq_len).
-                    kv_k = kv_k[:, :, : self.max_seq_len]
-                    kv_v = kv_v[:, :, : self.max_seq_len]
             else:
                 if req.prefix_id is not None:
                     self.prefix_misses += 1
