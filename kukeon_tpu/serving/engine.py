@@ -738,6 +738,8 @@ class ServingEngine:
 
     def _prefix_store(self, prefix_id: str, prompt: np.ndarray,
                       kv_k, kv_v) -> None:
+        if self._prefix_cache_size == 0:
+            return
         self._prefix_cache[prefix_id] = _CachedPrefix(
             tokens=tuple(int(t) for t in prompt),
             kv_k=kv_k, kv_v=kv_v, length=int(prompt.size),
