@@ -97,10 +97,14 @@ class Request:
 class _CachedPrefix:
     """Stored prompt KV for one prefix_id (device arrays)."""
 
-    tokens: tuple[int, ...]          # the exact prompt this KV encodes
-    kv_k: Any                        # [L, 1, Pb, KV, D] (bucketed length)
+    tokens: np.ndarray               # the exact prompt this KV encodes (int32)
+    kv_k: Any                        # [L, 1, Pb, KV, D], Pb a CANONICAL bucket
     kv_v: Any
     length: int                      # valid positions in the block
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.kv_k.nbytes + self.kv_v.nbytes)
 
 
 @dataclasses.dataclass
@@ -147,6 +151,7 @@ class ServingEngine:
         forward_fn=None,
         param_specs=None,
         prefix_cache_size: int = 8,
+        prefix_cache_bytes: int = 2 << 30,
     ):
         # Model pluggability: any forward with llama.forward's signature
         # ((params, cfg, tokens, positions, cache) -> (logits, cache')) and
@@ -251,11 +256,14 @@ class ServingEngine:
         # Prefix cache: prefix_id -> stored prompt KV (LRU, driver-thread
         # only). Agent sessions re-send a large shared/growing context with
         # every request; reusing its KV turns an O(context) prefill into an
-        # O(new tokens) one.
+        # O(new tokens) one. Bounded by BOTH entry count and device bytes —
+        # HBM is the constrained resource (one 8B entry at 8k context is
+        # ~1 GiB of K+V), so the byte budget is what prevents an OOM.
         from collections import OrderedDict
 
         self._prefix_cache: "OrderedDict[str, _CachedPrefix]" = OrderedDict()
         self._prefix_cache_size = max(0, prefix_cache_size)
+        self._prefix_cache_bytes = max(0, prefix_cache_bytes)
         self.prefix_hits = 0
         self.prefix_misses = 0
 
@@ -335,14 +343,22 @@ class ServingEngine:
             first = sample_per_slot(
                 last[None, :], key, temp[None], top_k[None], top_p[None]
             )[0]
-            # Clamp to slot size INSIDE the program: the excess rows are
-            # bucket padding by construction (prompt < max_seq_len), and an
-            # eager slice on a GSPMD-sharded output can hit unparseable
-            # named-sharding conversions.
+            # Re-bucket the output block to a CANONICAL shape inside the
+            # program (shapes are static at trace time): without this, a
+            # growing conversation would mint a new (Pb, S) pair — and a
+            # fresh full-model compile — every turn, and an eager reshape
+            # on a GSPMD-sharded output can hit unparseable named-sharding
+            # conversions. Canonical shapes keep the (Pb, S_tail) compile
+            # set small and shared with the miss path's insert shapes.
+            out_S = min(bucket_length(Pb + S), self.max_seq_len)
             out_k, out_v = cache.k, cache.v
-            if Pb + S > self.max_seq_len:
-                out_k = out_k[:, :, : self.max_seq_len]
-                out_v = out_v[:, :, : self.max_seq_len]
+            if Pb + S > out_S:
+                out_k = out_k[:, :, :out_S]
+                out_v = out_v[:, :, :out_S]
+            elif Pb + S < out_S:
+                pad = [(0, 0), (0, 0), (0, out_S - (Pb + S)), (0, 0), (0, 0)]
+                out_k = jnp.pad(out_k, pad)
+                out_v = jnp.pad(out_v, pad)
             return first, out_k, out_v
 
         def insert(state: DecodeState, kv_k, kv_v, length, slot, token):
@@ -730,7 +746,7 @@ class ServingEngine:
         if (
             e is not None
             and req.prompt.size > e.length
-            and tuple(int(t) for t in req.prompt[: e.length]) == e.tokens
+            and np.array_equal(req.prompt[: e.length], e.tokens)
         ):
             self._prefix_cache.move_to_end(req.prefix_id)
             return e
@@ -738,14 +754,21 @@ class ServingEngine:
 
     def _prefix_store(self, prefix_id: str, prompt: np.ndarray,
                       kv_k, kv_v) -> None:
-        if self._prefix_cache_size == 0:
+        if self._prefix_cache_size == 0 or self._prefix_cache_bytes == 0:
             return
         self._prefix_cache[prefix_id] = _CachedPrefix(
-            tokens=tuple(int(t) for t in prompt),
+            tokens=prompt.copy(),
             kv_k=kv_k, kv_v=kv_v, length=int(prompt.size),
         )
         self._prefix_cache.move_to_end(prefix_id)
-        while len(self._prefix_cache) > self._prefix_cache_size:
+        # Evict LRU-first past either bound. An entry that alone exceeds the
+        # byte budget evicts itself immediately — caching it would pin more
+        # HBM than the operator allowed.
+        while self._prefix_cache and (
+            len(self._prefix_cache) > self._prefix_cache_size
+            or sum(e.nbytes for e in self._prefix_cache.values())
+            > self._prefix_cache_bytes
+        ):
             self._prefix_cache.popitem(last=False)
 
     def _dispatch_prefill(self, req: Request, slot: int):
@@ -764,7 +787,7 @@ class ServingEngine:
             if cached is not None:
                 self.prefix_hits += 1
                 tail = req.prompt[cached.length:]
-                bucket = bucket_length(tail.size)
+                bucket = min(bucket_length(tail.size), self.max_seq_len)
                 tokens = np.zeros((1, bucket), np.int32)
                 tokens[0, : tail.size] = tail
                 first, kv_k, kv_v = self._prefill_ext(

@@ -334,3 +334,48 @@ class TestPrefixCache:
             while not r.done.is_set():
                 eng.step()
         assert set(eng._prefix_cache) == {"b", "c"}
+
+
+def test_prefix_cache_byte_budget_and_canonical_shapes():
+    """Stored blocks stay at canonical bucket shapes (bounded compile set)
+    and the byte budget evicts LRU-first; an over-budget single entry is
+    not kept."""
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+    sp = SamplingParams(temperature=0.0, max_new_tokens=2)
+
+    eng = ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=256)
+    prompt = np.arange(1, 70, dtype=np.int32) % cfg.vocab_size   # bucket 128
+    r = eng.submit(prompt, sp, prefix_id="a")
+    while not r.done.is_set():
+        eng.step()
+    from kukeon_tpu.serving.engine import PREFILL_BUCKETS
+    Pb = eng._prefix_cache["a"].kv_k.shape[2]
+    assert Pb in PREFILL_BUCKETS
+    entry_bytes = eng._prefix_cache["a"].nbytes   # 128-bucket entry
+    # Growing turn: the re-stored block is ALSO canonical (prefix bucket +
+    # tail bucket re-bucketed, not an ad-hoc sum).
+    grown = np.concatenate([prompt, np.asarray(r.generated, np.int32)])
+    r = eng.submit(grown, sp, prefix_id="a")
+    while not r.done.is_set():
+        eng.step()
+    Pb2 = eng._prefix_cache["a"].kv_k.shape[2]
+    assert Pb2 in PREFILL_BUCKETS or Pb2 == 256
+
+    # Budget that fits exactly one such entry: storing a second evicts the
+    # first; a budget smaller than one entry keeps none.
+    eng2 = ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=256,
+                         prefix_cache_bytes=entry_bytes)
+    for name in ("x", "y"):
+        r = eng2.submit(prompt, sp, prefix_id=name)
+        while not r.done.is_set():
+            eng2.step()
+    assert list(eng2._prefix_cache) == ["y"]
+
+    eng3 = ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=256,
+                         prefix_cache_bytes=entry_bytes // 2)
+    r = eng3.submit(prompt, sp, prefix_id="z")
+    while not r.done.is_set():
+        eng3.step()
+    assert len(eng3._prefix_cache) == 0
