@@ -156,18 +156,22 @@ def phase_serve(args) -> None:
     if args.checkpoint:
         params, cfg = checkpoints.load_quantized(args.checkpoint)
         tokenizer = load_tokenizer(args.checkpoint)
-        model_name = "llama3-8b (int8)"
+        model_id, model_name = "llama3-8b", "llama3-8b (int8)"
         sessions, prompt_len, new_tokens, max_seq = 4, 128, 128, 1024
     else:
         cfg = llama.llama_tiny()
         params = llama.init_params(jax.random.key(0), cfg)
         tokenizer = None
-        model_name = "tiny (cpu smoke)"
+        model_id, model_name = "tiny", "tiny (cpu smoke)"
         sessions, prompt_len, new_tokens, max_seq = 2, 32, 16, 128
 
+    buckets = None
+    if args.prefill_buckets:
+        buckets = tuple(int(b) for b in args.prefill_buckets.split(","))
     engine = ServingEngine(
         cfg, params, mesh, num_slots=sessions, max_seq_len=max_seq,
         decode_chunk=args.decode_chunk, kv_cache_int8=args.kv_int8,
+        prefill_buckets=buckets,
     )
 
     rng = np.random.default_rng(0)
@@ -212,9 +216,16 @@ def phase_serve(args) -> None:
         "backend": backend,
         "n_chips": n_chips,
         "model": model_name,
+        "model_id": model_id,
         "sessions": sessions,
         "tok_per_s": rates[len(rates) // 2],
         "trials": [round(r, 1) for r in rates],
+        "config": {
+            "decode_chunk": engine.decode_chunk,
+            "kv_cache_int8": engine.kv_cache_int8,
+            "prefill_buckets": (list(engine.prefill_buckets)
+                                if buckets else None),
+        },
     }), flush=True)
 
 
@@ -305,6 +316,105 @@ def phase_ab(args) -> None:
                 f.write(json.dumps({
                     "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                     "note": "A/B sweep", **line,
+                }) + "\n")
+        except OSError:
+            pass
+    print(json.dumps(line))
+
+
+def phase_autotune(args) -> None:
+    """Autotune sweep (the tentpole of the decode roofline campaign):
+    decode-chunk × int8-KV × prefill-bucket arms, each measured by the
+    serve phase in its own chip-owning subprocess, winner persisted to the
+    serving tune profile (~/.kuke/serving_tune.json, KUKEON_TUNE_PATH to
+    override) keyed by model+backend+chip-count. ServingEngine/ServingCell
+    consult that profile at boot, so one sweep permanently configures
+    production serving. Run as `python bench.py --autotune`; works on the
+    CPU smoke when no TPU is reachable (the profile then keys as cpu and
+    never leaks into TPU serving)."""
+    backend, n_chips = detect_backend()
+    _log(f"autotune: backend={backend} n_chips={n_chips}")
+    qdir = None
+    model_id = "tiny"
+    if backend != "cpu":
+        qdir = ensure_quantized_8b()
+        model_id = "llama3-8b"
+
+    # Arm grid. CPU smoke keeps it small (each arm boots a fresh engine);
+    # TPU sweeps the full chunk ladder. The coarse-bucket arm measures
+    # whether fewer/larger prefill buckets (fewer compiles, more padded
+    # prefill compute) beat the default ladder for this workload.
+    chunks = (4, 16, 64) if backend == "tpu" else (4, 16)
+    coarse = "256,1024,4096" if backend == "tpu" else "64,256"
+    arms: list[tuple[str, dict]] = []
+    for c in chunks:
+        for kv in (False, True):
+            arms.append((f"chunk{c}" + ("+kvint8" if kv else ""),
+                         {"decode_chunk": c, "kv_int8": kv,
+                          "prefill_buckets": None}))
+    arms.append((f"chunk{chunks[-1]}+coarse-buckets",
+                 {"decode_chunk": chunks[-1], "kv_int8": False,
+                  "prefill_buckets": coarse}))
+
+    results: dict = {}
+    best_name, best_cfg, best_rate = None, None, -1.0
+    for name, cfg in arms:
+        cmd = [sys.executable, os.path.abspath(__file__), "--phase", "serve",
+               "--decode-chunk", str(cfg["decode_chunk"])]
+        if cfg["kv_int8"]:
+            cmd += ["--kv-int8"]
+        if cfg["prefill_buckets"]:
+            cmd += ["--prefill-buckets", cfg["prefill_buckets"]]
+        if qdir:
+            cmd += ["--checkpoint", qdir]
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=2400, cwd=REPO, env=subprocess_env())
+        except subprocess.TimeoutExpired:
+            _log(f"autotune arm {name}: timed out")
+            results[name] = None
+            continue
+        if out.returncode != 0:
+            _log(f"autotune arm {name}: rc={out.returncode}\n{out.stderr[-1200:]}")
+            results[name] = None
+            continue
+        serve = json.loads(out.stdout.strip().splitlines()[-1])
+        rate = float(serve["tok_per_s"])
+        results[name] = {"tok_per_s": round(rate, 2), "trials": serve["trials"]}
+        _log(f"autotune arm {name}: {results[name]}")
+        if rate > best_rate:
+            best_name, best_cfg, best_rate = name, cfg, rate
+
+    line: dict = {
+        "metric": f"autotune sweep, {model_id}, {n_chips} chip(s) [{backend}]",
+        "arms": results,
+        "backend": backend,
+        "model": model_id,
+    }
+    if best_cfg is not None:
+        sys.path.insert(0, REPO)
+        from kukeon_tpu.serving import tuning
+
+        buckets = (tuple(int(b) for b in best_cfg["prefill_buckets"].split(","))
+                   if best_cfg["prefill_buckets"] else None)
+        path = tuning.save(model_id, backend, n_chips, tuning.ServingTune(
+            decode_chunk=best_cfg["decode_chunk"],
+            kv_cache_int8=best_cfg["kv_int8"],
+            prefill_buckets=buckets,
+            tok_per_s=best_rate,
+        ))
+        line["best"] = {"arm": best_name, "tok_per_s": round(best_rate, 2)}
+        line["profile"] = {"path": path,
+                           "key": tuning.profile_key(model_id, backend, n_chips)}
+        _log(f"autotune: winner {best_name} ({best_rate:.1f} tok/s) -> {path}")
+    else:
+        line["error"] = "every arm failed; profile not written"
+    if backend == "tpu":
+        try:
+            with open(os.path.join(REPO, "BENCH_TPU_HISTORY.jsonl"), "a") as f:
+                f.write(json.dumps({
+                    "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    "note": "autotune sweep", **line,
                 }) + "\n")
         except OSError:
             pass
@@ -453,7 +563,10 @@ def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", default="all",
-                    choices=["all", "serve", "embed", "ab"])
+                    choices=["all", "serve", "embed", "ab", "autotune"])
+    # Sweep the serving perf levers and persist the winner to the tune
+    # profile that ServingEngine/ServingCell read at boot (phase_autotune).
+    ap.add_argument("--autotune", action="store_true")
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--decode-chunk", type=int,
                     default=int(os.environ.get("KUKEON_BENCH_CHUNK", "16")))
@@ -462,8 +575,13 @@ def main() -> None:
     # cache is ~6% of step bytes next to 8 GB of int8 weights).
     ap.add_argument("--kv-int8", action="store_true",
                     default=os.environ.get("KUKEON_BENCH_KV_INT8", "") == "1")
+    # Comma-separated prefill bucket ladder override (e.g. "256,1024,4096").
+    ap.add_argument("--prefill-buckets", default=None)
     args = ap.parse_args()
 
+    if args.autotune or args.phase == "autotune":
+        phase_autotune(args)
+        return
     if args.phase == "serve":
         phase_serve(args)
         return

@@ -398,6 +398,7 @@ def forward(
     positions: jnp.ndarray,
     cache: KVCache | None = None,
     attn_impl: str = "auto",
+    logit_positions: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, KVCache | None]:
     """Run the decoder.
 
@@ -408,9 +409,15 @@ def forward(
       cache: optional KVCache; when given, new K/V are written at each
         sequence's current length and attention runs against the cache.
         ``positions`` must equal ``cache.lengths[:, None] + arange(S)``.
+      logit_positions: optional [B] int32 sequence indices; when given, the
+        LM head runs at ONLY those positions and logits come back [B, 1, V].
+        Prefill needs one next-token distribution, not S_bucket of them —
+        at 8B shapes the full head is an S×H×128k matmul plus a [S, 128k]
+        f32 tensor, bigger than the rest of the prefill combined.
 
     Returns:
-      (logits [B, S, V] float32, updated cache or None).
+      (logits [B, S, V] float32 — [B, 1, V] with ``logit_positions`` —
+      and the updated cache or None).
     """
     c = cfg
     B, S = tokens.shape
@@ -419,6 +426,7 @@ def forward(
     # The fused decode path implements its own (reference-equivalent) masked
     # attention; honor an explicit request for a specific impl by falling
     # through to the generic path instead of silently ignoring it.
+    # (logit_positions is moot at S == 1: there is only one position.)
     if cache is not None and S == 1 and attn_impl in ("auto", "reference"):
         return _decode_forward(params, c, x, positions, cache, B)
 
@@ -503,6 +511,8 @@ def forward(
         new_cache = None
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    if logit_positions is not None:
+        x = jnp.take_along_axis(x, logit_positions[:, None, None], axis=1)
     return _logits(params, c, x), new_cache
 
 

@@ -61,8 +61,11 @@ class MoEConfig:
     dtype: Any = jnp.bfloat16
     router_z_coef: float = 1e-3
     load_balance_coef: float = 1e-2
-    # Read by ServingEngine's pallas auto-routing; the MoE forward has no
-    # pallas path, so this stays False (the engine requires the field).
+    # Route quantized decode matmuls (attention trunk via llama._mm, expert
+    # stacks via ops.int8_matmul.int8_matmul_expert) through the Pallas
+    # int8 kernel — same contract as LlamaConfig.int8_pallas, same engine
+    # auto-routing, XLA fallback off-TPU. Prefill always keeps XLA's
+    # dequant-fused dots (MXU-bound there).
     int8_pallas: bool = False
 
     @property
@@ -206,10 +209,19 @@ def init_quantized_params_host(cfg: MoEConfig, seed: int = 0) -> Params:
     return params
 
 
-def _expert_mm(x: jnp.ndarray, w, eq: str) -> jnp.ndarray:
+def _expert_mm(x: jnp.ndarray, w, eq: str, pallas: bool = False) -> jnp.ndarray:
     """Per-expert batched matmul ('ech,ehi->eci' or 'eci,eih->ech') for
-    plain or int8 ({"q","s"}) expert stacks; dequant fuses into the dot."""
+    plain or int8 ({"q","s"}) expert stacks; dequant fuses into the dot.
+
+    ``pallas=True`` routes int8 stacks through the Pallas decode kernel
+    (both einsums above are x [E, C, K] @ w [E, K, N], so one helper covers
+    them); the helper itself falls back to the XLA fused einsum for odd
+    shapes, prefill-sized C, or non-TPU backends."""
     if llama._is_q(w):
+        if pallas:
+            from kukeon_tpu.ops.int8_matmul import int8_matmul_expert
+
+            return int8_matmul_expert(x, w["q"], w["s"])
         raw = jnp.einsum(eq, x, w["q"].astype(x.dtype))
         return raw * w["s"][:, None, :].astype(x.dtype)
     return jnp.einsum(eq, x, w)
@@ -237,7 +249,8 @@ def _capacity(cfg: MoEConfig, n_tokens: int, inference: bool = False) -> int:
 
 
 def moe_block(h: jnp.ndarray, w: dict, cfg: MoEConfig,
-              inference: bool = False) -> tuple[jnp.ndarray, dict]:
+              inference: bool = False,
+              pallas: bool = False) -> tuple[jnp.ndarray, dict]:
     """Sparse-MoE SwiGLU over [B, S, H] -> ([B, S, H], aux losses).
 
     GShard dense-dispatch: top-k routing -> static-capacity one-hot dispatch
@@ -277,10 +290,10 @@ def moe_block(h: jnp.ndarray, w: dict, cfg: MoEConfig,
     # Dispatch -> per-expert batches -> SwiGLU -> combine.
     xe = jnp.einsum("nec,nh->ech", dispatch, x).astype(c.dtype)  # [E, C, H]
     gate = jax.nn.silu(
-        _expert_mm(xe, w["w_gate"], "ech,ehi->eci").astype(jnp.float32)
+        _expert_mm(xe, w["w_gate"], "ech,ehi->eci", pallas).astype(jnp.float32)
     ).astype(c.dtype)
-    up = _expert_mm(xe, w["w_up"], "ech,ehi->eci")
-    ye = _expert_mm(gate * up, w["w_down"], "eci,eih->ech")      # [E, C, H]
+    up = _expert_mm(xe, w["w_up"], "ech,ehi->eci", pallas)
+    ye = _expert_mm(gate * up, w["w_down"], "eci,eih->ech", pallas)  # [E, C, H]
     y = jnp.einsum("nec,ech->nh", combine.astype(c.dtype), ye)
 
     # Aux losses (f32): Switch load-balance (E * sum_e f_e * P_e; 1.0 at
@@ -305,25 +318,29 @@ def _decode_forward(
     per-layer new K/V; the cache is updated once per step with per-slot
     in-place slice writes — cache bytes stream through HBM exactly once).
     The MoE block runs at N = B tokens, where dense dispatch is a few KB
-    and capacity is exact (no drops)."""
+    and capacity is exact (no drops). With ``cfg.int8_pallas`` every
+    quantized matmul — attention trunk and expert stacks — reads int8
+    straight from HBM through the Pallas kernel instead of materializing a
+    dequantized copy per step."""
     from kukeon_tpu.ops.attention import decode_gqa_attention
 
     offsets = cache.lengths
+    pl8 = c.int8_pallas
 
     def layer_step(x, layer):
         w, ck, cv = layer
         h = rms_norm(x, w["attn_norm"], c.rms_norm_eps)
-        q = _mm(h, w["wq"]).reshape(B, 1, c.num_heads, c.head_dim)
-        k = _mm(h, w["wk"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
-        v = _mm(h, w["wv"]).reshape(B, 1, c.num_kv_heads, c.head_dim)
+        q = _mm(h, w["wq"], pl8).reshape(B, 1, c.num_heads, c.head_dim)
+        k = _mm(h, w["wk"], pl8).reshape(B, 1, c.num_kv_heads, c.head_dim)
+        v = _mm(h, w["wv"], pl8).reshape(B, 1, c.num_kv_heads, c.head_dim)
         q = apply_rope(q, positions, c.rope_theta)
         k = apply_rope(k, positions, c.rope_theta)
 
         attn = decode_gqa_attention(q, k, v, ck, cv, offsets)
-        x = x + _mm(attn.reshape(B, 1, c.q_dim), w["wo"])
+        x = x + _mm(attn.reshape(B, 1, c.q_dim), w["wo"], pl8)
 
         h = rms_norm(x, w["mlp_norm"], c.rms_norm_eps)
-        y, _ = moe_block(h, w, c, inference=True)
+        y, _ = moe_block(h, w, c, inference=True, pallas=pl8)
         return x + y, (k, v)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -339,7 +356,7 @@ def _decode_forward(
     new_cache = KVCache(k=k_upd, v=v_upd, lengths=cache.lengths + 1)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
-    return llama._logits(params, c, x), new_cache
+    return llama._logits(params, c, x, pl8), new_cache
 
 
 def forward_with_aux(
@@ -349,11 +366,14 @@ def forward_with_aux(
     positions: jnp.ndarray,
     cache: KVCache | None = None,
     attn_impl: str = "auto",
+    logit_positions: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, KVCache | None, dict]:
     """Run the MoE decoder; returns (logits, cache', aux-loss dict).
 
     Cache semantics identical to ``llama.forward`` (same KVCache layout, so
-    the serving engine's insert/decode programs carry over unchanged).
+    the serving engine's insert/decode programs carry over unchanged);
+    ``logit_positions`` [B] restricts the LM head to one position per
+    sequence exactly as in ``llama.forward`` (logits come back [B, 1, V]).
 
     A cache marks the inference path: expert capacity switches to the
     no-drop/wide policy (see :func:`_capacity`) — serving must not silently
@@ -423,6 +443,8 @@ def forward_with_aux(
         new_cache = None
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    if logit_positions is not None:
+        x = jnp.take_along_axis(x, logit_positions[:, None, None], axis=1)
     logits = llama._logits(params, c, x)
     aux = {"load_balance": lb / c.num_layers, "router_z": z / c.num_layers}
     return logits, new_cache, aux
@@ -435,9 +457,10 @@ def forward(
     positions: jnp.ndarray,
     cache: KVCache | None = None,
     attn_impl: str = "auto",
+    logit_positions: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, KVCache | None]:
     """Serving-signature forward (drop-in for ``llama.forward``)."""
     logits, new_cache, _ = forward_with_aux(
-        params, cfg, tokens, positions, cache, attn_impl
+        params, cfg, tokens, positions, cache, attn_impl, logit_positions
     )
     return logits, new_cache

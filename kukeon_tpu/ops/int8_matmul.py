@@ -96,3 +96,22 @@ def int8_matmul(h: jnp.ndarray, q: jnp.ndarray, s: jnp.ndarray,
         out_specs=pl.BlockSpec((Bp, T), lambda j: (0, j)),
     )(h, q, s2)
     return out[:B] if Bp != B else out
+
+
+def int8_matmul_expert(x: jnp.ndarray, q: jnp.ndarray,
+                       s: jnp.ndarray) -> jnp.ndarray:
+    """Per-expert batched int8 matmul: x [E, C, K] @ q [E, K, N] * s [E, N]
+    -> [E, C, N] (the MoE decode expert stacks: w_gate/w_up/w_down).
+
+    On TPU at decode-sized C the E expert blocks run through the Pallas
+    kernel one expert at a time (E is small and static, so this is a fixed
+    unroll, and each weight block streams HBM as int8); everywhere else —
+    CPU, odd shapes, prefill-sized C — the XLA dequant-fused einsum is the
+    right tool and the fallback.
+    """
+    E, C, K = x.shape
+    N = q.shape[-1]
+    if (K % 128) or (N % 128) or C > 64 or jax.default_backend() != "tpu":
+        raw = jnp.einsum("eck,ekn->ecn", x, q.astype(x.dtype))
+        return raw * s[:, None, :].astype(x.dtype)
+    return jnp.stack([int8_matmul(x[e], q[e], s[e]) for e in range(E)])

@@ -31,12 +31,20 @@ from kukeon_tpu.ops.attention import (
     attention_reference,
     repeat_kv,
 )
-from kukeon_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR
+from kukeon_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    ambient_mesh,
+    axis_size,
+    shard_map,
+)
 
 
 def _ulysses_local(q, k, v, q_pos, kv_pos, axis_name: str):
     """Per-device body under shard_map: local arrays are [B, S/n, h, D]."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if q.shape[2] % n or k.shape[2] % n:
         raise ValueError(
             f"ulysses needs seq axis ({n}) to divide the local head counts "
@@ -79,14 +87,14 @@ def ulysses_attention(
     over ``axis_name``; returns [B, S, NH, D] with q's sharding.
     """
     if mesh is None:
-        mesh = jax.sharding.get_abstract_mesh()
+        mesh = ambient_mesh()
     mesh_axes = set(mesh.axis_names)
     batch_axes = tuple(a for a in (AXIS_DATA, AXIS_FSDP) if a in mesh_axes) or None
     head_axis = AXIS_TENSOR if AXIS_TENSOR in mesh_axes else None
 
     qkv_spec = P(batch_axes, axis_name, head_axis, None)
     pos_spec = P(batch_axes, axis_name)
-    return jax.shard_map(
+    return shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, pos_spec, pos_spec),

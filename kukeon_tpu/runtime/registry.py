@@ -244,11 +244,18 @@ class RegistryClient:
 
     def _send(self, method: str, path_or_url: str, data=None,
               content_type: str | None = None, timeout: int = 300,
-              retry_auth: bool = True, ok_codes: tuple[int, ...] = ()):
+              retry_auth: bool = True, ok_codes: tuple[int, ...] = (),
+              max_redirects: int = 5):
         """Non-GET request with the shared auth story. ``data`` may be bytes
         or a seekable file object (streamed, Content-Length from its size).
         Returns (status, headers). HTTP errors whose code is in ``ok_codes``
         are returned instead of raised (HEAD-existence probes).
+
+        3xx responses are followed to their Location (S3-backed registries
+        answer blob/manifest PUTs with 307/302 to object storage): same
+        method and body — file bodies re-seek to 0 per hop — except 303,
+        which per RFC converts to a bodyless GET. Auth is re-derived per
+        hop below, so credentials never travel to a cross-host Location.
 
         Built on http.client, NOT urllib.request: urllib silently replaces
         an explicit Content-Length with Transfer-Encoding: chunked for file
@@ -258,40 +265,51 @@ class RegistryClient:
 
         url = (path_or_url if path_or_url.startswith("http")
                else self._url(path_or_url))
-        split = urllib.parse.urlsplit(url)
-        path = split.path + (f"?{split.query}" if split.query else "")
-        headers: dict[str, str] = {}
-        if content_type:
-            headers["Content-Type"] = content_type
-        if data is not None and hasattr(data, "seek"):
-            data.seek(0, os.SEEK_END)
-            headers["Content-Length"] = str(data.tell())
-            data.seek(0)
-        elif data is not None:
-            headers["Content-Length"] = str(len(data))
-        # Auth only travels to the registry itself. Registries commonly
-        # redirect blob uploads to object storage via an absolute Location;
-        # forwarding Basic/Bearer there would hand credentials to a third
-        # party (docker-style clients strip auth on cross-host redirects).
-        if split.netloc == self.registry:
-            headers.update(self.auth.headers())
-        conn_cls = (http.client.HTTPSConnection if split.scheme == "https"
-                    else http.client.HTTPConnection)
-        conn = conn_cls(split.netloc, timeout=timeout)
-        try:
-            conn.request(method, path, body=data, headers=headers)
-            r = conn.getresponse()
-            r.read()
-            status, rheaders = r.status, dict(r.getheaders())
-        except OSError as e:
-            raise KukeonError(f"registry {self.registry}: {e}") from None
-        finally:
-            conn.close()
+        for _hop in range(max_redirects + 1):
+            split = urllib.parse.urlsplit(url)
+            path = split.path + (f"?{split.query}" if split.query else "")
+            headers: dict[str, str] = {}
+            if content_type and data is not None:
+                headers["Content-Type"] = content_type
+            if data is not None and hasattr(data, "seek"):
+                data.seek(0, os.SEEK_END)
+                headers["Content-Length"] = str(data.tell())
+                data.seek(0)
+            elif data is not None:
+                headers["Content-Length"] = str(len(data))
+            # Auth only travels to the registry itself. Registries commonly
+            # redirect blob uploads to object storage via an absolute
+            # Location; forwarding Basic/Bearer there would hand credentials
+            # to a third party (docker-style clients strip auth on
+            # cross-host redirects).
+            if split.netloc == self.registry:
+                headers.update(self.auth.headers())
+            conn_cls = (http.client.HTTPSConnection if split.scheme == "https"
+                        else http.client.HTTPConnection)
+            conn = conn_cls(split.netloc, timeout=timeout)
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                r = conn.getresponse()
+                r.read()
+                status, rheaders = r.status, dict(r.getheaders())
+            except OSError as e:
+                raise KukeonError(f"registry {self.registry}: {e}") from None
+            finally:
+                conn.close()
+            if status in (301, 302, 303, 307, 308) and _hop < max_redirects:
+                loc = rheaders.get("Location") or rheaders.get("location")
+                if loc:
+                    url = urllib.parse.urljoin(url, loc)
+                    if status == 303:
+                        method, data, content_type = "GET", None, None
+                    continue
+            break
         if status == 401 and retry_auth and self.auth.handle_challenge(
             rheaders.get("WWW-Authenticate", "")
         ):
             return self._send(method, path_or_url, data, content_type,
-                              timeout, retry_auth=False, ok_codes=ok_codes)
+                              timeout, retry_auth=False, ok_codes=ok_codes,
+                              max_redirects=max_redirects)
         if status >= 400 and status not in ok_codes:
             raise KukeonError(
                 f"registry {self.registry}: {method} {split.path} -> {status}"

@@ -31,6 +31,15 @@ MODELS = {}
 EMBEDDING_MODELS = {}
 
 
+def _trailing_fffd(s: str) -> int:
+    """Length of the run of U+FFFD replacement chars at the end of ``s``
+    (the provisional decode of an incomplete multi-byte codepoint)."""
+    n = 0
+    while n < len(s) and s[-1 - n] == "�":
+        n += 1
+    return n
+
+
 _CACHE_DIR: str | None = None   # the versioned dir actually configured
 
 
@@ -110,7 +119,8 @@ def _register_models():
 class ServingCell:
     def __init__(self, model: str, *, num_slots: int, max_seq_len: int | None,
                  checkpoint: str | None, dtype: str | None, seed: int = 0,
-                 kv_cache_int8: bool = False):
+                 kv_cache_int8: bool | None = None,
+                 decode_chunk: int | None = None):
         import jax
 
         _enable_compilation_cache()
@@ -147,11 +157,14 @@ class ServingCell:
         if model in MOE_MODELS:
             # MoE family: same engine, moe forward + expert-aware specs.
             # int8-KV is a llama-decode-path feature the MoE forward doesn't
-            # have yet — fail loudly rather than serving garbage.
+            # have yet — fail loudly rather than serving garbage; an
+            # unspecified flag pins False so a tuning profile can never
+            # switch it on behind the guard.
             if kv_cache_int8:
                 raise SystemExit(
                     f"model {model!r} does not support --kv-cache-int8 yet"
                 )
+            kv_cache_int8 = False
             from kukeon_tpu.models import hf_convert, moe
             from kukeon_tpu.parallel import moe_specs_for_params
 
@@ -189,11 +202,16 @@ class ServingCell:
         # async_load: the multi-GB weight transfer streams in the background
         # while warmup()'s precompile pass AOT-compiles the programs — cold
         # start pays max(transfer, compile) instead of their sum.
+        # model_name routes the engine to the persisted autotune profile
+        # (bench.py --autotune): levers the operator left unset
+        # (decode_chunk/kv_cache_int8 None) boot at the swept winner for
+        # this model+backend+chip-count.
         self.engine = ServingEngine(
             cfg, params, mesh, num_slots=num_slots,
             max_seq_len=max_seq_len or min(cfg.max_seq_len, 4096),
             kv_cache_int8=kv_cache_int8, async_load=True,
             forward_fn=forward_fn, param_specs=param_specs,
+            decode_chunk=decode_chunk, model_name=model,
         )
         from kukeon_tpu.serving.tokenizer import load_tokenizer
 
@@ -319,7 +337,25 @@ class ServingCell:
                     full = full[:hit]
                     stopped = True
                     r.cancel()
-                delta, emitted = full[len(emitted):], full
+                out = full
+                if not (done or stopped):
+                    # decode() is NOT append-only: a codepoint split across
+                    # tokens decodes to U+FFFD now and is rewritten when the
+                    # next token completes it. Hold back trailing U+FFFDs
+                    # until they stabilize (the final event flushes them, so
+                    # genuine replacement chars still arrive) — emitted text
+                    # then never needs retracting.
+                    out = full[:len(full) - _trailing_fffd(full)]
+                if out.startswith(emitted):
+                    delta = out[len(emitted):]
+                else:
+                    # Belt: a tokenizer that rewrites non-tail text (never
+                    # the byte/BPE ones) — re-sync at the common prefix
+                    # rather than slicing at a wrong offset.
+                    n = min(len(out), len(emitted))
+                    i = next((j for j in range(n) if out[j] != emitted[j]), n)
+                    delta = out[i:]
+                emitted = out
                 if delta or not stopped:
                     yield {"token": tok, "text": delta}
             if done:
@@ -353,6 +389,11 @@ class ServingCell:
             "prefixCache": {"hits": self.engine.prefix_hits,
                             "misses": self.engine.prefix_misses,
                             "entries": len(self.engine._prefix_cache)},
+            "tuning": {
+                "decodeChunk": self.engine.decode_chunk,
+                "kvCacheInt8": self.engine.kv_cache_int8,
+                "fromProfile": self.engine.tune is not None,
+            },
         }
 
 
@@ -515,9 +556,23 @@ def make_handler(cell: ServingCell):
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.end_headers()
-            for obj in itertools.chain([first], gen):
-                self.wfile.write((json.dumps(obj) + "\n").encode())
-                self.wfile.flush()
+            try:
+                for obj in itertools.chain([first], gen):
+                    self.wfile.write((json.dumps(obj) + "\n").encode())
+                    self.wfile.flush()
+            except OSError:
+                pass   # client went away mid-stream; nothing to tell it
+            except Exception as e:  # noqa: BLE001 — headers are already out
+                # A second status line (do_POST's 500 path) would land
+                # inside the open ndjson body and corrupt the stream; the
+                # in-band terminal error line is the protocol here.
+                try:
+                    self.wfile.write(
+                        (json.dumps({"error": f"{type(e).__name__}: {e}"})
+                         + "\n").encode())
+                    self.wfile.flush()
+                except OSError:
+                    pass
 
     return Handler
 
@@ -531,7 +586,10 @@ def main(argv=None) -> int:
     ap.add_argument("--max-seq-len", type=int, default=None)
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--dtype", default=None)
-    ap.add_argument("--kv-cache-int8", action="store_true")
+    # None (flag absent) lets the persisted autotune profile decide; the
+    # explicit flag always wins (serving/tuning.py).
+    ap.add_argument("--kv-cache-int8", action="store_true", default=None)
+    ap.add_argument("--decode-chunk", type=int, default=None)
     ap.add_argument("--no-warmup", action="store_true")
     args = ap.parse_args(argv)
 
@@ -547,7 +605,7 @@ def main(argv=None) -> int:
         cell = ServingCell(
             args.model, num_slots=args.num_slots, max_seq_len=args.max_seq_len,
             checkpoint=args.checkpoint, dtype=args.dtype,
-            kv_cache_int8=args.kv_cache_int8,
+            kv_cache_int8=args.kv_cache_int8, decode_chunk=args.decode_chunk,
         )
         # Warmup before the engine thread starts: step() is single-driver.
         if not args.no_warmup:

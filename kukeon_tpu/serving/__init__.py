@@ -8,7 +8,9 @@ from kukeon_tpu.serving.sampling import (  # noqa: F401
     SamplingParams,
     sample,
     sample_per_slot,
+    slot_sampling_arrays,
 )
+from kukeon_tpu.serving.tuning import ServingTune  # noqa: F401
 from kukeon_tpu.serving.embedding import (  # noqa: F401
     EMBED_BUCKETS,
     EmbeddingEngine,

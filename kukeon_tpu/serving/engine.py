@@ -48,7 +48,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from kukeon_tpu.models import llama
 from kukeon_tpu.parallel import sharding as shd
-from kukeon_tpu.serving.sampling import SamplingParams, sample_per_slot
+from kukeon_tpu.parallel.mesh import set_mesh
+from kukeon_tpu.serving.sampling import (
+    SamplingParams,
+    sample_per_slot,
+    slot_sampling_arrays,
+)
 
 PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096)
 
@@ -116,13 +121,14 @@ class _InflightChunk:
     slots: list[tuple[int, "Request"]]       # (slot, request) at dispatch time
 
 
-def bucket_length(n: int) -> int:
-    for b in PREFILL_BUCKETS:
+def bucket_length(n: int, buckets: tuple[int, ...] = PREFILL_BUCKETS) -> int:
+    for b in buckets:
         if n <= b:
             return b
     # Beyond the largest bucket: round up to a multiple of it (rare path;
-    # still a bounded compile cache because lengths are multiples of 4096).
-    last = PREFILL_BUCKETS[-1]
+    # still a bounded compile cache because lengths are multiples of the
+    # largest bucket).
+    last = buckets[-1]
     return ((n + last - 1) // last) * last
 
 
@@ -143,15 +149,17 @@ class ServingEngine:
         num_slots: int = 8,
         max_seq_len: int | None = None,
         eos_ids: tuple[int, ...] = (),
-        decode_chunk: int = 16,
+        decode_chunk: int | None = None,
         seed: int = 0,
         int8_pallas: bool | None = None,
-        kv_cache_int8: bool = False,
+        kv_cache_int8: bool | None = None,
         async_load: bool = False,
         forward_fn=None,
         param_specs=None,
         prefix_cache_size: int = 8,
         prefix_cache_bytes: int = 2 << 30,
+        prefill_buckets: tuple[int, ...] | None = None,
+        model_name: str | None = None,
     ):
         # Model pluggability: any forward with llama.forward's signature
         # ((params, cfg, tokens, positions, cache) -> (logits, cache')) and
@@ -160,6 +168,46 @@ class ServingEngine:
         # matching PartitionSpec tree (default: the Llama specs).
         self._forward = forward_fn or llama.forward
         self._param_specs = param_specs
+        # Forwards that accept ``logit_positions`` let prefill compute the
+        # LM head at ONE position instead of all S bucket rows — at 8B
+        # shapes that removes a [S, 128k] f32 logits tensor (and its S×H×V
+        # matmul) from every prefill, work that otherwise stalls decode.
+        import inspect
+
+        try:
+            self._fwd_logit_positions = (
+                "logit_positions" in inspect.signature(self._forward).parameters
+            )
+        except (TypeError, ValueError):
+            self._fwd_logit_positions = False
+
+        # Tuning profile: levers not pinned by the caller fall back to the
+        # persisted autotune winner for this (model, backend, chip-count),
+        # then to defaults. bench.py --autotune writes the profile; a stale
+        # or missing one silently degrades to defaults (serving/tuning.py).
+        self.tune: "Any | None" = None
+        if model_name and (decode_chunk is None or kv_cache_int8 is None
+                           or prefill_buckets is None):
+            from kukeon_tpu.serving import tuning
+
+            self.tune = tuning.load(
+                model_name, jax.default_backend(),
+                mesh.size if mesh is not None else 0,
+            )
+        if self.tune is not None:
+            if decode_chunk is None:
+                decode_chunk = self.tune.decode_chunk
+            if kv_cache_int8 is None:
+                kv_cache_int8 = self.tune.kv_cache_int8
+            if prefill_buckets is None:
+                prefill_buckets = self.tune.prefill_buckets
+        decode_chunk = 16 if decode_chunk is None else decode_chunk
+        kv_cache_int8 = bool(kv_cache_int8)
+        self.model_name = model_name
+        self.prefill_buckets = (
+            tuple(sorted({int(b) for b in prefill_buckets}))
+            if prefill_buckets else PREFILL_BUCKETS
+        )
         # int8_pallas=None -> auto: route quantized decode matmuls through
         # the Pallas kernel on a single-chip TPU mesh when the operator opts
         # in (KUKEON_INT8_PALLAS=1). Microbenchmarks on v5e measured the
@@ -201,6 +249,12 @@ class ServingEngine:
         # quantization happens once, at slot insert.
         self.kv_cache_int8 = kv_cache_int8
         self._key = jax.random.key(seed)
+        # Transfer-counting seam (the decode roofline contract): every
+        # blocking device→host readback goes through _fetch and every
+        # host→device array upload through _upload, so tests can assert the
+        # decode loop performs ≤1 blocking transfer per chunk instead of
+        # guessing from timings. "chunks" counts dispatched decode chunks.
+        self.sync_stats = {"fetches": 0, "uploads": 0, "chunks": 0}
 
         if mesh is None:
             raise ValueError("ServingEngine requires a mesh (use make_mesh(tensor=1) for one device)")
@@ -223,7 +277,7 @@ class ServingEngine:
                 try:
                     self.params = shd.shard_params(
                         params, mesh, specs=self._param_specs)
-                    with jax.set_mesh(mesh):
+                    with set_mesh(mesh):
                         self.state = self._init_state()
                 except Exception as e:  # noqa: BLE001 — surfaced by _ensure_loaded
                     self._load_exc = e
@@ -235,7 +289,7 @@ class ServingEngine:
         else:
             self.params = shd.shard_params(params, mesh,
                                            specs=self._param_specs)
-            with jax.set_mesh(mesh):
+            with set_mesh(mesh):
                 self.state = self._init_state()
             self._loaded.set()
 
@@ -245,7 +299,11 @@ class ServingEngine:
         self._inflight: _InflightChunk | None = None
         # Device-resident sampling arrays, re-uploaded only when the slot
         # composition changes (each host->device upload costs a link RT).
+        # The dirty flag is set exactly where composition changes (slot
+        # insert/release, failure sweep) so steady-state chunks touch no
+        # host memory at all — not even a numpy rebuild-and-compare.
         self._sampling_dev: tuple | None = None
+        self._sampling_dirty = True
         self._pending: queue.Queue[Request] = queue.Queue()
         self._next_id = 0
         self._lock = threading.Lock()
@@ -307,14 +365,30 @@ class ServingEngine:
     def _build_programs(self):
         cfg = self.cfg
         fwd = self._forward
+        last_pos_ok = self._fwd_logit_positions
+
+        def last_logits(params, tokens, positions, cache, length):
+            """(last-position logits [V], cache') — via the forward's
+            single-position LM head when it has one (prefill then never
+            materializes the [S_bucket, V] f32 logits block), else by
+            slicing the full logits."""
+            if last_pos_ok:
+                logits, cache = fwd(
+                    params, cfg, tokens, positions, cache,
+                    logit_positions=jnp.reshape(length - 1, (1,)),
+                )
+                return logits[0, 0], cache
+            logits, cache = fwd(params, cfg, tokens, positions, cache)
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], length - 1, keepdims=False)
+            return last, cache
 
         def prefill(params, tokens, length, key, temp, top_k, top_p):
             """tokens [1, S_bucket] -> (first sampled token, kv block)."""
             S = tokens.shape[1]
             positions = jnp.arange(S, dtype=jnp.int32)[None, :]
             cache = llama.KVCache.create(cfg, 1, S)
-            logits, cache = fwd(params, cfg, tokens, positions, cache)
-            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, keepdims=False)
+            last, cache = last_logits(params, tokens, positions, cache, length)
             first = sample_per_slot(
                 last[None, :], key, temp[None], top_k[None], top_p[None]
             )[0]
@@ -338,8 +412,7 @@ class ServingEngine:
                 lengths=jnp.full((1,), plen, jnp.int32),
             )
             positions = plen + jnp.arange(S, dtype=jnp.int32)[None, :]
-            logits, cache = fwd(params, cfg, tokens, positions, cache)
-            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, keepdims=False)
+            last, cache = last_logits(params, tokens, positions, cache, length)
             first = sample_per_slot(
                 last[None, :], key, temp[None], top_k[None], top_p[None]
             )[0]
@@ -350,7 +423,7 @@ class ServingEngine:
             # on a GSPMD-sharded output can hit unparseable named-sharding
             # conversions. Canonical shapes keep the (Pb, S_tail) compile
             # set small and shared with the miss path's insert shapes.
-            out_S = min(bucket_length(Pb + S), self.max_seq_len)
+            out_S = min(self._bucket(Pb + S), self.max_seq_len)
             out_k, out_v = cache.k, cache.v
             if Pb + S > out_S:
                 out_k = out_k[:, :, :out_S]
@@ -426,6 +499,20 @@ class ServingEngine:
             decode_chunk_fn, static_argnums=(6,), donate_argnums=(1,)
         )
 
+    def _bucket(self, n: int) -> int:
+        return bucket_length(n, self.prefill_buckets)
+
+    def _fetch(self, x) -> np.ndarray:
+        """Blocking device→host readback, counted (the roofline budget is
+        ≤1 per decode chunk — tests/test_serving.py asserts it here)."""
+        self.sync_stats["fetches"] += 1
+        return np.asarray(x)
+
+    def _upload(self, x):
+        """Host→device array upload, counted."""
+        self.sync_stats["uploads"] += 1
+        return jnp.asarray(x)
+
     def _ensure_loaded(self):
         """Block until the (possibly async) weight transfer finished."""
         if not self._loaded.is_set():
@@ -479,9 +566,9 @@ class ServingEngine:
         top_ks = jnp.zeros((B,), jnp.int32)
         top_ps = jnp.ones((B,), jnp.float32)
 
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             buckets = sorted({
-                min(bucket_length(max(1, n)), self.max_seq_len)
+                min(self._bucket(max(1, n)), self.max_seq_len)
                 for n in prompt_lens
             })
             for L in buckets:
@@ -570,7 +657,7 @@ class ServingEngine:
             size *= 4
             chunk_sizes.add(size)
         temps, top_ks, top_ps = self._slot_sampling_arrays()
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             for k in sorted(chunk_sizes):
                 self._key, k1 = jax.random.split(self._key)
                 self.state, _ = self._decode_chunk(
@@ -605,11 +692,12 @@ class ServingEngine:
                 self._fail_all(e)
                 # Keep serving: state may be poisoned, so rebuild it.
                 try:
-                    with jax.set_mesh(self.mesh):
+                    with set_mesh(self.mesh):
                         self.state = self._init_state()
                     self._slot_req = [None] * self.num_slots
                     self._slot_len = [0] * self.num_slots
                     self._inflight = None
+                    self._sampling_dirty = True
                 except Exception:  # noqa: BLE001
                     self._running = False
                     raise
@@ -633,6 +721,7 @@ class ServingEngine:
         for slot, req in list(self._active_requests()):
             self._slot_req[slot] = None
             finish(req)
+        self._sampling_dirty = True
         while True:
             try:
                 req = self._pending.get_nowait()
@@ -725,8 +814,8 @@ class ServingEngine:
             # One stacked fetch for every prefill's first token (per-request
             # int() would pay one link round-trip each); the decode chunk
             # dispatched above is already running behind it on the device.
-            with jax.set_mesh(self.mesh):
-                firsts = np.asarray(jnp.stack([f for _, f in prefills]))
+            with set_mesh(self.mesh):
+                firsts = self._fetch(jnp.stack([f for _, f in prefills]))
             for (req, _), first in zip(prefills, firsts):
                 self._emit(req, int(first))
 
@@ -782,28 +871,28 @@ class ServingEngine:
         n = req.prompt.size
         sp = req.sampling
         cached = self._prefix_lookup(req)
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self._key, k1 = jax.random.split(self._key)
             if cached is not None:
                 self.prefix_hits += 1
                 tail = req.prompt[cached.length:]
-                bucket = min(bucket_length(tail.size), self.max_seq_len)
+                bucket = min(self._bucket(tail.size), self.max_seq_len)
                 tokens = np.zeros((1, bucket), np.int32)
                 tokens[0, : tail.size] = tail
                 first, kv_k, kv_v = self._prefill_ext(
                     self.params, cached.kv_k, cached.kv_v, cached.length,
-                    jnp.asarray(tokens), tail.size, k1,
+                    self._upload(tokens), tail.size, k1,
                     jnp.float32(sp.temperature), jnp.int32(sp.top_k),
                     jnp.float32(sp.top_p),
                 )
             else:
                 if req.prefix_id is not None:
                     self.prefix_misses += 1
-                bucket = min(bucket_length(n), self.max_seq_len)
+                bucket = min(self._bucket(n), self.max_seq_len)
                 tokens = np.zeros((1, bucket), np.int32)
                 tokens[0, :n] = req.prompt
                 first, kv_k, kv_v = self._prefill(
-                    self.params, jnp.asarray(tokens), n, k1,
+                    self.params, self._upload(tokens), n, k1,
                     jnp.float32(sp.temperature), jnp.int32(sp.top_k),
                     jnp.float32(sp.top_p),
                 )
@@ -814,6 +903,7 @@ class ServingEngine:
         req.first_token_at = time.monotonic()
         self._slot_req[slot] = req
         self._slot_len[slot] = n + 1   # prompt + the first generated token's kv-to-be
+        self._sampling_dirty = True
         return req, first
 
     def _chunk_size(self) -> int:
@@ -842,44 +932,41 @@ class ServingEngine:
         return size
 
     def _slot_sampling_arrays(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-        temps = np.zeros((self.num_slots,), np.float32)
-        top_ks = np.zeros((self.num_slots,), np.int32)
-        top_ps = np.ones((self.num_slots,), np.float32)
-        for slot, req in self._active_requests():
-            temps[slot] = req.sampling.temperature
-            top_ks[slot] = req.sampling.top_k
-            top_ps[slot] = req.sampling.top_p
-        return temps, top_ks, top_ps
+        return slot_sampling_arrays(self._active_requests(), self.num_slots)
 
     def _sampling_dev_arrays(self):
-        """Device copies of the per-slot sampling arrays, cached across
-        chunks while the slot->request mapping is unchanged."""
-        temps, top_ks, top_ps = self._slot_sampling_arrays()
-        cached = self._sampling_dev
-        if cached is not None and (
-            np.array_equal(cached[0], temps)
-            and np.array_equal(cached[1], top_ks)
-            and np.array_equal(cached[2], top_ps)
-        ):
-            return cached[3]
-        dev = (jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps))
-        self._sampling_dev = (temps, top_ks, top_ps, dev)
-        return dev
+        """Device copies of the per-slot sampling arrays, re-uploaded only
+        when the slot->request mapping changed since the last chunk."""
+        if self._sampling_dev is None or self._sampling_dirty:
+            temps, top_ks, top_ps = self._slot_sampling_arrays()
+            self._sampling_dev = (
+                self._upload(temps), self._upload(top_ks), self._upload(top_ps)
+            )
+            self._sampling_dirty = False
+        return self._sampling_dev
 
     def _dispatch_decode_chunk(self) -> _InflightChunk:
         k = self._chunk_size()
         temps_d, top_ks_d, top_ps_d = self._sampling_dev_arrays()
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self._key, k1 = jax.random.split(self._key)
             self.state, toks = self._decode_chunk(
                 self.params, self.state, k1, temps_d, top_ks_d, top_ps_d, k,
             )
+        self.sync_stats["chunks"] += 1
+        # Start the device→host DMA of the token block now: by the time
+        # _flush_inflight wants it (after the NEXT chunk is dispatched), the
+        # copy has overlapped device compute instead of serializing with it.
+        try:
+            toks.copy_to_host_async()
+        except AttributeError:
+            pass
         return _InflightChunk(tokens=toks, k=k, slots=self._active_requests())
 
     def _flush_inflight(self):
         """Fetch + emit the previously dispatched chunk's token block."""
         chunk = self._inflight
-        toks = np.asarray(chunk.tokens)   # [B, K] — single transfer per chunk
+        toks = self._fetch(chunk.tokens)  # [B, K] — single transfer per chunk
         for slot, req in chunk.slots:
             if req.done.is_set():
                 continue   # finished meanwhile (overshoot chunk) — discard
@@ -910,6 +997,7 @@ class ServingEngine:
     def _release_slot(self, req: Request, cancelled: bool = False):
         slot = req.slot
         self._slot_req[slot] = None
+        self._sampling_dirty = True
         self.state = DecodeState(
             cache=self.state.cache,
             tokens=self.state.tokens,

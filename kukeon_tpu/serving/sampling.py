@@ -6,6 +6,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @dataclasses.dataclass(frozen=True)
@@ -18,6 +19,24 @@ class SamplingParams:
     # the slot frees the moment one is generated; the stop token itself is
     # included in the output, clients strip it if unwanted).
     stop_tokens: tuple[int, ...] = ()
+
+
+def slot_sampling_arrays(
+    slot_requests, num_slots: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-slot (temps, top_ks, top_ps) host arrays for
+    :func:`sample_per_slot`, from (slot, request) pairs whose requests carry
+    a :class:`SamplingParams`. Empty slots sample greedily (temp 0), which
+    is also a no-op for inactive slots in the decode program."""
+    temps = np.zeros((num_slots,), np.float32)
+    top_ks = np.zeros((num_slots,), np.int32)
+    top_ps = np.ones((num_slots,), np.float32)
+    for slot, req in slot_requests:
+        sp = req.sampling
+        temps[slot] = sp.temperature
+        top_ks[slot] = sp.top_k
+        top_ps[slot] = sp.top_p
+    return temps, top_ks, top_ps
 
 
 def sample(logits: jnp.ndarray, key: jax.Array, params: SamplingParams) -> jnp.ndarray:

@@ -1,0 +1,137 @@
+"""Persisted serving-tune profiles (the autotune → production seam).
+
+``bench.py --autotune`` sweeps the serving perf levers (decode-chunk size,
+int8 KV cache, prefill bucket ladder) on whatever backend is up and persists
+the winning configuration here; ``ServingEngine``/``ServingCell`` consult the
+profile at boot. A one-time sweep therefore permanently configures production
+serving — no operator has to re-derive the chunk size per model/chip-count.
+
+The profile file (default ``~/.kuke/serving_tune.json``, override with
+``KUKEON_TUNE_PATH``) is a single JSON object keyed by
+``model|backend|n_chips``: a profile tuned for llama3-8b on one TPU chip is
+never applied to a CPU smoke of the same model, a different model, or a
+different slice size — stale keys are simply ignored. This module is
+import-light on purpose (no jax): the bench orchestrator reads/writes
+profiles without touching any accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+
+_DEFAULT_PATH = os.path.join("~", ".kuke", "serving_tune.json")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingTune:
+    """One winning serving configuration for a (model, backend, chips) key."""
+
+    decode_chunk: int = 16
+    kv_cache_int8: bool = False
+    # None keeps the engine's default bucket ladder.
+    prefill_buckets: tuple[int, ...] | None = None
+    # Provenance (not consumed by the engine, kept for operators/debugging).
+    tok_per_s: float | None = None
+    tuned_at: str | None = None
+
+    def to_dict(self) -> dict:
+        d = {
+            "decode_chunk": int(self.decode_chunk),
+            "kv_cache_int8": bool(self.kv_cache_int8),
+        }
+        if self.prefill_buckets:
+            d["prefill_buckets"] = [int(b) for b in self.prefill_buckets]
+        if self.tok_per_s is not None:
+            d["tok_per_s"] = round(float(self.tok_per_s), 2)
+        if self.tuned_at:
+            d["tuned_at"] = self.tuned_at
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "ServingTune":
+        buckets = d.get("prefill_buckets")
+        return ServingTune(
+            decode_chunk=max(1, int(d["decode_chunk"])),
+            kv_cache_int8=bool(d.get("kv_cache_int8", False)),
+            prefill_buckets=(tuple(sorted({int(b) for b in buckets}))
+                             if buckets else None),
+            tok_per_s=(float(d["tok_per_s"])
+                       if d.get("tok_per_s") is not None else None),
+            tuned_at=d.get("tuned_at"),
+        )
+
+
+def profile_path(path: str | None = None) -> str:
+    return os.path.expanduser(
+        path or os.environ.get("KUKEON_TUNE_PATH") or _DEFAULT_PATH
+    )
+
+
+def profile_key(model: str, backend: str, n_chips: int) -> str:
+    return f"{model}|{backend}|{int(n_chips)}"
+
+
+def _read_all(path: str) -> dict:
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else {}
+    except (OSError, ValueError):
+        # Missing or corrupt profile: serving must boot with defaults, never
+        # die to a bad tuning file.
+        return {}
+
+
+def load(model: str | None, backend: str, n_chips: int,
+         path: str | None = None) -> ServingTune | None:
+    """The stored tune for this exact (model, backend, chips) key, or None.
+
+    Any mismatch — other model, other backend, other slice size, unreadable
+    file, malformed entry — is a miss, not an error: a stale profile must
+    degrade to defaults silently."""
+    if not model:
+        return None
+    entry = _read_all(profile_path(path)).get(
+        profile_key(model, backend, n_chips)
+    )
+    if not isinstance(entry, dict):
+        return None
+    try:
+        return ServingTune.from_dict(entry)
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def save(model: str, backend: str, n_chips: int, tune: ServingTune,
+         path: str | None = None) -> str:
+    """Merge ``tune`` into the profile file under its key; returns the path.
+
+    Read-modify-write of the whole file with an atomic rename, so profiles
+    for other models/backends survive and a crashed writer never leaves a
+    truncated file behind."""
+    p = profile_path(path)
+    os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+    entries = _read_all(p)
+    if tune.tuned_at is None:
+        tune = dataclasses.replace(
+            tune, tuned_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        )
+    entries[profile_key(model, backend, n_chips)] = tune.to_dict()
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(p) or ".",
+                               prefix=".serving_tune-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(entries, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, p)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return p

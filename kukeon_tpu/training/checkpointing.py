@@ -84,7 +84,31 @@ def restore_checkpoint(root: str, template: TrainState,
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {root}")
     abstract = template if _is_abstract(template) else abstract_like(template)
-    return ocp.StandardCheckpointer().restore(_step_dir(root, step), abstract)
+    # Leaves whose template sharding is single-device (optimizer counts and
+    # other scalars minted by an un-annotated jit) must restore as
+    # mesh-REPLICATED: a restore commits its outputs, and a scalar committed
+    # to device 0 next to mesh-wide params makes every later jitted step
+    # reject the mixed device sets. Borrow the mesh from any NamedSharded
+    # leaf (the params always are).
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = next((a.sharding.mesh for a in jax.tree.leaves(abstract)
+                 if isinstance(a.sharding, NamedSharding)), None)
+    if mesh is not None:
+        repl = NamedSharding(mesh, PartitionSpec())
+
+        def widen(a):
+            if isinstance(a.sharding, NamedSharding):
+                return a
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=repl)
+
+        abstract = jax.tree.map(widen, abstract)
+    restored = ocp.StandardCheckpointer().restore(_step_dir(root, step),
+                                                  abstract)
+    # Belt for orbax versions that ignore the target sharding on scalar
+    # leaves: re-place onto it (a no-op where the layout already matches).
+    shardings = jax.tree.map(lambda a: a.sharding, abstract)
+    return jax.device_put(restored, shardings)
 
 
 def _is_abstract(tree) -> bool:

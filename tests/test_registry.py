@@ -54,7 +54,8 @@ class FakeRegistry:
 
     def __init__(self, *, require_auth: bool = False,
                  user: str = "kuke", password: str = "sekrit",
-                 upload_redirect_base: str | None = None):
+                 upload_redirect_base: str | None = None,
+                 put_redirect_base: str | None = None):
         self.blobs: dict[str, bytes] = {}
         self.manifests: dict[tuple[str, str], tuple[bytes, str]] = {}
         self.require_auth = require_auth
@@ -64,6 +65,10 @@ class FakeRegistry:
         # Absolute base URL to redirect blob uploads to (the object-storage
         # redirect pattern); None keeps uploads on this server.
         self.upload_redirect_base = upload_redirect_base
+        # Answer blob PUTs themselves with 307 -> this base (S3-backed
+        # registries redirect the byte PUT, not just the session Location).
+        self.put_redirect_base = put_redirect_base
+        self.put_redirects_sent: list[str] = []
         self.upload_auth_seen: list[str | None] = []
 
         reg = self
@@ -154,8 +159,17 @@ class FakeRegistry:
             def do_PUT(self):
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
-                reg.upload_auth_seen.append(self.headers.get("Authorization"))
                 split = self.path.split("?")[0].split("/")
+                if reg.put_redirect_base and "uploads" in split:
+                    # 307 preserves method+body; the client must re-PUT the
+                    # bytes at the Location (drain the body first so the
+                    # connection stays usable).
+                    reg.put_redirects_sent.append(self.path)
+                    self._send(307, headers=[
+                        ("Location", f"{reg.put_redirect_base}{self.path}"),
+                    ])
+                    return
+                reg.upload_auth_seen.append(self.headers.get("Authorization"))
                 if "uploads" in split:
                     # blob PUT at the session Location with ?digest=
                     from urllib.parse import parse_qs, urlsplit
@@ -718,6 +732,38 @@ class TestPush:
             assert all(a is None for a in storage.upload_auth_seen)
             # ...while the manifest PUT to the registry itself carried it.
             assert primary.upload_auth_seen
+            assert all(a and a.startswith("Basic ")
+                       for a in primary.upload_auth_seen)
+        finally:
+            primary.close()
+            storage.close()
+
+    def test_blob_put_307_redirect_followed(self, tmp_path, monkeypatch):
+        """A registry answering the blob byte-PUT itself with 307 to object
+        storage (S3-backed pattern): _send must re-issue the PUT — same
+        body, re-seeked — at the Location, with credentials stripped on the
+        cross-host hop (ADVICE r5: this used to fail the push with
+        'PUT -> 307')."""
+        storage = FakeRegistry()
+        primary = FakeRegistry(put_redirect_base=f"http://{storage.host}")
+        monkeypatch.setenv("KUKE_REGISTRY_USER", "kuke")
+        monkeypatch.setenv("KUKE_REGISTRY_PASSWORD", "sekrit")
+        store, m = self._local_image(tmp_path)
+        try:
+            registry.push(store, m.ref, dest=f"{primary.host}/team/myapp:v1")
+            # Both blob PUTs were redirected and their bytes landed intact
+            # (the storage fake digest-verifies every PUT body).
+            assert len(primary.put_redirects_sent) == 2
+            assert len(storage.blobs) == 2
+            assert storage.upload_auth_seen
+            assert all(a is None for a in storage.upload_auth_seen)
+            # Manifest stayed on the registry, authenticated; it references
+            # exactly the blobs that landed on the storage host.
+            body, _mt = primary.manifests[("team/myapp", "v1")]
+            mani = json.loads(body)
+            digests = {mani["config"]["digest"]} | {
+                layer["digest"] for layer in mani["layers"]}
+            assert digests == set(storage.blobs)
             assert all(a and a.startswith("Basic ")
                        for a in primary.upload_auth_seen)
         finally:

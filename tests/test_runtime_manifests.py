@@ -201,3 +201,94 @@ def test_serving_cell_prefix_id_passthrough():
 
     with _pytest.raises(ValueError, match="prefixId"):
         cell.generate({"prompt": "x", "prefixId": 42})
+
+
+def test_stream_deltas_survive_split_utf8_codepoint():
+    """A multi-byte codepoint split across tokens decodes to U+FFFD until
+    its last byte arrives; the stream must hold the provisional tail back
+    (never emit a replacement char that will be rewritten) and the joined
+    deltas must equal the final text (ADVICE r5, ISSUE 1 satellite)."""
+    import threading
+
+    import numpy as np  # noqa: F401 — prompt encoding below
+
+    from kukeon_tpu.runtime.serving_cell import ServingCell
+
+    cell = ServingCell("tiny", num_slots=2, max_seq_len=64,
+                       checkpoint=None, dtype=None)
+
+    # Script the engine: "h", then "é" split across two byte tokens, "!".
+    script = [0x68] + list("é".encode()) + [0x21]
+
+    class FakeReq:
+        def __init__(self):
+            self.done = threading.Event()
+            self.error = None
+            self.cancelled = False
+
+        def cancel(self):
+            self.cancelled = True
+
+    class FakeEngine:
+        _running = True   # consumer loop reads straight off the queue
+
+        def submit(self, prompt, sp, emit=None, prefix_id=None):
+            r = FakeReq()
+            for i, tok in enumerate(script):
+                emit(tok, i == len(script) - 1)
+            r.done.set()
+            return r
+
+    cell.engine = FakeEngine()
+    recs = list(cell.generate_stream({"prompt": "x", "maxNewTokens": 8}))
+    final = recs[-1]
+    deltas = [r["text"] for r in recs[:-1]]
+    assert "".join(deltas) == "hé!" == final["text"]
+    assert not any("�" in d for d in deltas)
+    # The split codepoint's first byte emitted an empty (held back) delta,
+    # completed on the next token.
+    assert deltas == ["h", "", "é", "!"]
+
+
+def test_ndjson_midstream_error_stays_in_band():
+    """A generator failure AFTER headers went out must surface as a
+    terminal {"error": ...} ndjson line — not as a second interleaved HTTP
+    status line corrupting the open stream (ADVICE r5, ISSUE 1 satellite)."""
+    import http.client
+    import json
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    from kukeon_tpu.runtime.serving_cell import make_handler
+
+    class BoomCell:
+        model_name = "boom"
+
+        def generate(self, req):
+            raise AssertionError("non-stream path not under test")
+
+        def generate_stream(self, req):
+            yield {"token": 1, "text": "a"}
+            yield {"token": 2, "text": "b"}
+            raise RuntimeError("device lost mid-stream")
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(BoomCell()))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          server.server_address[1], timeout=10)
+        conn.request("POST", "/v1/generate", body=json.dumps({
+            "prompt": "x", "stream": True}), headers={
+            "Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        raw = resp.read()
+        conn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+    assert b"HTTP/" not in raw          # no second status line in the body
+    lines = [json.loads(x) for x in raw.decode().splitlines()]
+    assert lines[0] == {"token": 1, "text": "a"}
+    assert lines[1] == {"token": 2, "text": "b"}
+    assert lines[2]["error"].startswith("RuntimeError")

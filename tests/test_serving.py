@@ -379,3 +379,80 @@ def test_prefix_cache_byte_budget_and_canonical_shapes():
     while not r.done.is_set():
         eng3.step()
     assert len(eng3._prefix_cache) == 0
+
+
+def test_decode_host_sync_budget():
+    """The decode roofline contract (ISSUE 1): steady-state decode performs
+    exactly ONE blocking device→host transfer per dispatched chunk (the
+    token-block fetch) and re-uploads sampling arrays only when the slot
+    composition changes — asserted through the engine's transfer-counting
+    seam instead of guessed from timings."""
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+    eng = ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=128,
+                        decode_chunk=4)
+
+    base = dict(eng.sync_stats)
+    req = eng.submit(np.arange(1, 9, dtype=np.int32),
+                     SamplingParams(max_new_tokens=24))
+    prefill_steps = 0
+    while not req.done.is_set():
+        before = eng.sync_stats["uploads"]
+        eng.step()
+        if eng.sync_stats["uploads"] > before:
+            prefill_steps += 1
+    d = {k: eng.sync_stats[k] - base[k] for k in base}
+    assert len(req.generated) == 24
+    # Several chunks ran (24 tokens at chunk<=4), each fetched exactly once;
+    # the only extra fetch is the prefill's stacked first-token readback.
+    # A trailing overshoot chunk may stay unfetched when the request
+    # finishes during the flush of the previous one.
+    assert d["chunks"] >= 5
+    assert d["fetches"] <= d["chunks"] + 1
+    assert d["fetches"] >= d["chunks"] - 1
+    # Uploads happen only at composition changes: prompt tokens + the three
+    # sampling arrays once, NOT per chunk.
+    assert prefill_steps == 1
+    assert d["uploads"] == 4, d
+
+    # Steady state with an unchanged slot map: a second request re-uploads
+    # once (composition changed at insert + release), still O(1) not
+    # O(chunks).
+    base = dict(eng.sync_stats)
+    req = eng.submit(np.arange(3, 17, dtype=np.int32),
+                     SamplingParams(max_new_tokens=24))
+    while not req.done.is_set():
+        eng.step()
+    d = {k: eng.sync_stats[k] - base[k] for k in base}
+    assert d["chunks"] >= 5
+    assert d["uploads"] == 4, d
+
+
+def test_submit_rejects_overlong_prompt():
+    """Prompts that cannot fit the KV slot fail loudly at submit() — on
+    BOTH the fresh path and the prefix-cache hit path (ADVICE r5: the hit
+    path used to silently truncate KV rows instead)."""
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+    eng = ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=64)
+
+    with pytest.raises(ValueError, match="max_seq_len"):
+        eng.submit(np.ones((64,), np.int32))        # == max_seq_len
+    with pytest.raises(ValueError, match="max_seq_len"):
+        eng.submit(np.ones((100,), np.int32))       # > max_seq_len
+    with pytest.raises(ValueError, match="non-empty"):
+        eng.submit(np.ones((0,), np.int32))
+
+    # Seed a prefix, then try to extend it past the window: the hit path
+    # must reject at submit too, and the engine must still serve afterwards.
+    sp = SamplingParams(temperature=0.0, max_new_tokens=2)
+    prefix = np.arange(1, 50, dtype=np.int32)
+    r = eng.submit(prefix, sp, prefix_id="sess")
+    while not r.done.is_set():
+        eng.step()
+    with pytest.raises(ValueError, match="max_seq_len"):
+        eng.submit(np.arange(1, 80, dtype=np.int32), sp, prefix_id="sess")
+    ok = eng.generate(np.arange(1, 10, dtype=np.int32), sp)
+    assert len(ok) == 2

@@ -1,0 +1,125 @@
+"""Serving tune profiles: persistence round-trip, stale-key hygiene, and
+the autotune → engine/cell boot seam (ISSUE 1 tentpole)."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from kukeon_tpu.models import llama
+from kukeon_tpu.parallel import make_mesh
+from kukeon_tpu.serving import SamplingParams, ServingEngine
+from kukeon_tpu.serving import tuning
+
+
+@pytest.fixture()
+def tune_path(tmp_path, monkeypatch):
+    p = str(tmp_path / "serving_tune.json")
+    monkeypatch.setenv("KUKEON_TUNE_PATH", p)
+    return p
+
+
+class TestProfileFile:
+    def test_round_trip(self, tune_path):
+        t = tuning.ServingTune(decode_chunk=64, kv_cache_int8=True,
+                               prefill_buckets=(128, 32), tok_per_s=261.2)
+        assert tuning.save("llama3-8b", "tpu", 1, t) == tune_path
+        got = tuning.load("llama3-8b", "tpu", 1)
+        assert got.decode_chunk == 64
+        assert got.kv_cache_int8 is True
+        assert got.prefill_buckets == (32, 128)   # normalized sorted
+        assert got.tok_per_s == 261.2
+        assert got.tuned_at            # stamped at save time
+
+    def test_keys_coexist(self, tune_path):
+        tuning.save("llama3-8b", "tpu", 1, tuning.ServingTune(decode_chunk=64))
+        tuning.save("tiny", "cpu", 1, tuning.ServingTune(decode_chunk=4))
+        tuning.save("llama3-8b", "tpu", 8, tuning.ServingTune(decode_chunk=16))
+        assert tuning.load("llama3-8b", "tpu", 1).decode_chunk == 64
+        assert tuning.load("tiny", "cpu", 1).decode_chunk == 4
+        assert tuning.load("llama3-8b", "tpu", 8).decode_chunk == 16
+
+    def test_stale_key_is_a_miss(self, tune_path):
+        """A profile tuned for another model, backend, or chip-count must
+        never be applied."""
+        tuning.save("llama3-8b", "tpu", 1, tuning.ServingTune(decode_chunk=64))
+        assert tuning.load("llama3-1b", "tpu", 1) is None
+        assert tuning.load("llama3-8b", "cpu", 1) is None
+        assert tuning.load("llama3-8b", "tpu", 8) is None
+        assert tuning.load(None, "tpu", 1) is None
+
+    def test_corrupt_or_missing_file_degrades(self, tune_path):
+        assert tuning.load("tiny", "cpu", 1) is None     # missing
+        with open(tune_path, "w") as f:
+            f.write("{ not json")
+        assert tuning.load("tiny", "cpu", 1) is None     # corrupt
+        with open(tune_path, "w") as f:
+            json.dump({"tiny|cpu|1": {"kv_cache_int8": True}}, f)
+        assert tuning.load("tiny", "cpu", 1) is None     # malformed entry
+        # And save repairs the file rather than crashing on it.
+        tuning.save("tiny", "cpu", 1, tuning.ServingTune(decode_chunk=4))
+        assert tuning.load("tiny", "cpu", 1).decode_chunk == 4
+
+
+class TestEngineBootPickup:
+    def _build(self, **kw):
+        cfg = llama.llama_tiny()
+        params = llama.init_params(jax.random.key(0), cfg)
+        mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+        return ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=128,
+                             **kw)
+
+    def test_fresh_engine_loads_profile(self, tune_path):
+        """The acceptance seam: a fresh ServingEngine boot picks up every
+        persisted lever — chunk size, int8 KV (visible in the allocated
+        cache), bucket ladder — and still generates correctly."""
+        tuning.save("tiny", jax.default_backend(), 1, tuning.ServingTune(
+            decode_chunk=64, kv_cache_int8=True, prefill_buckets=(32, 128)))
+        eng = self._build(model_name="tiny")
+        assert eng.tune is not None
+        assert eng.decode_chunk == 64
+        assert eng.kv_cache_int8 and eng.state.cache.quantized
+        assert eng.prefill_buckets == (32, 128)
+        toks = eng.generate(np.arange(1, 9, dtype=np.int32),
+                            SamplingParams(max_new_tokens=4))
+        assert len(toks) == 4
+
+    def test_explicit_args_beat_profile(self, tune_path):
+        tuning.save("tiny", jax.default_backend(), 1, tuning.ServingTune(
+            decode_chunk=64, kv_cache_int8=True))
+        eng = self._build(model_name="tiny", decode_chunk=8,
+                          kv_cache_int8=False)
+        assert eng.decode_chunk == 8
+        assert not eng.kv_cache_int8 and not eng.state.cache.quantized
+
+    def test_stale_profile_boots_defaults(self, tune_path):
+        tuning.save("llama3-8b", jax.default_backend(), 1,
+                    tuning.ServingTune(decode_chunk=64, kv_cache_int8=True))
+        eng = self._build(model_name="tiny")
+        assert eng.tune is None
+        assert eng.decode_chunk == 16          # default
+        assert not eng.kv_cache_int8
+
+    def test_no_model_name_never_reads_profile(self, tune_path):
+        with open(tune_path, "w") as f:
+            f.write("{ not json")   # would explode if read un-defensively
+        eng = self._build()
+        assert eng.tune is None and eng.decode_chunk == 16
+
+
+def test_serving_cell_boots_from_profile(tune_path, monkeypatch):
+    """ServingCell passes its model name through, so the HTTP cell boots at
+    the swept winner and reports it in /v1/stats."""
+    from kukeon_tpu.runtime.serving_cell import ServingCell
+
+    n_chips = len(jax.devices())
+    tuning.save("tiny", jax.default_backend(), n_chips,
+                tuning.ServingTune(decode_chunk=4, tok_per_s=99.0))
+    cell = ServingCell("tiny", num_slots=2, max_seq_len=64,
+                       checkpoint=None, dtype=None)
+    assert cell.engine.decode_chunk == 4
+    t = cell.stats()["tuning"]
+    assert t == {"decodeChunk": 4, "kvCacheInt8": False, "fromProfile": True}
+    out = cell.generate({"prompt": "hello", "maxNewTokens": 4})
+    assert out["numTokens"] == 4
