@@ -172,6 +172,15 @@ class ModelSpec:
     # into the attention dots). Weights are governed by ``dtype``; this
     # governs only the per-request KV cache.
     kv_cache_int8: bool = False
+    # Admission control (serving resilience): bound on queued-not-yet-
+    # slotted requests — past it the cell sheds with 429 + Retry-After
+    # instead of growing an unbounded backlog. None = the serving cell's
+    # own default; 0 = unbounded (explicit operator opt-out).
+    max_pending: int | None = None
+    # Default per-request deadline in seconds (a request's own deadlineS
+    # wins). Expired requests get an in-band timeout terminal event and
+    # free their slot. None/0 = no default deadline.
+    deadline_s: float | None = None
     # Model cells live INSIDE the space network by default: the server binds
     # the cell's bridge IP, in-space agent cells reach it there, and the
     # space's default-deny egress governs its traffic (BASELINE config 4).

@@ -10,21 +10,32 @@ reserved for multi-host TPU-VM workers (same reservation as the reference).
 
 from __future__ import annotations
 
+import errno
 import json
 import socket
 import threading
+import time
 
 from kukeon_tpu.runtime.errors import KukeonError, NotSupported, Unavailable, from_code
 
 DIAL_TIMEOUT_S = 5.0   # reference: rpcclient.go:34
+# Transient-dial retry budget: during a daemon restart the socket is briefly
+# missing (ENOENT) or unaccepted (ECONNREFUSED). CLI calls in that window
+# retry with a short backoff (the attach client's PING_BACKOFF_S pattern)
+# instead of hard-failing into the operator's face.
+DIAL_RETRY_BUDGET_S = 2.0
+DIAL_RETRY_BACKOFF_S = 0.1
+_TRANSIENT_ERRNOS = (errno.ECONNREFUSED, errno.ENOENT)
 
 
 class UnixClient:
     """Persistent-connection JSON-RPC client (lazy dial, thread-safe)."""
 
-    def __init__(self, socket_path: str, timeout_s: float = DIAL_TIMEOUT_S):
+    def __init__(self, socket_path: str, timeout_s: float = DIAL_TIMEOUT_S,
+                 retry_budget_s: float = DIAL_RETRY_BUDGET_S):
         self.socket_path = socket_path
         self.timeout_s = timeout_s
+        self.retry_budget_s = retry_budget_s
         self._sock: socket.socket | None = None
         self._file = None
         self._id = 0
@@ -35,15 +46,23 @@ class UnixClient:
     def _ensure_conn(self):
         if self._sock is not None:
             return
-        s = socket.socket(socket.AF_UNIX)
-        s.settimeout(self.timeout_s)
-        try:
-            s.connect(self.socket_path)
-        except OSError as e:
-            raise Unavailable(
-                f"cannot reach kukeond at {self.socket_path}: {e} "
-                f"(is the daemon running? try `kuke daemon start`)"
-            ) from None
+        deadline = time.monotonic() + self.retry_budget_s
+        while True:
+            s = socket.socket(socket.AF_UNIX)
+            s.settimeout(self.timeout_s)
+            try:
+                s.connect(self.socket_path)
+                break
+            except OSError as e:
+                s.close()
+                if (e.errno in _TRANSIENT_ERRNOS
+                        and time.monotonic() < deadline):
+                    time.sleep(DIAL_RETRY_BACKOFF_S)
+                    continue
+                raise Unavailable(
+                    f"cannot reach kukeond at {self.socket_path}: {e} "
+                    f"(is the daemon running? try `kuke daemon start`)"
+                ) from None
         s.settimeout(None)
         self._sock = s
         self._file = s.makefile("rwb")

@@ -56,6 +56,16 @@ def probe_tpu_runtime(timeout_s: float = 20.0) -> tuple[str, str]:
     import subprocess
     import sys
 
+    # Fault seam: KUKEON_FAULTS=devices.probe_wedged:1 makes the probe
+    # report a wedged runtime without needing a chip to actually wedge —
+    # the watchdog/restart path is tested by injection, not by timing.
+    from kukeon_tpu import faults
+
+    try:
+        faults.maybe_fail("devices.probe_wedged")
+    except faults.FaultInjected as e:
+        return "wedged", f"fault-injected: {e}"
+
     code = (
         "import time, numpy, jax;"
         "t0 = time.monotonic();"

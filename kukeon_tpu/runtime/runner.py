@@ -242,6 +242,11 @@ class Runner:
             cmd += ["--dtype", m.dtype]
         if m.kv_cache_int8:
             cmd += ["--kv-cache-int8"]
+        if m.max_pending is not None:
+            # 0 is meaningful (explicit unbounded opt-out) — pass it through.
+            cmd += ["--max-pending", str(m.max_pending)]
+        if m.deadline_s:
+            cmd += ["--deadline-s", str(m.deadline_s)]
         return t.ContainerSpec(
             name="model-server",
             command=cmd,

@@ -6,10 +6,21 @@ runner grants chips via TPU_VISIBLE_DEVICES before exec; this process builds
 the mesh over whatever devices JAX exposes and serves:
 
   GET  /v1/health    -> {"status": "ok", ...}  (the reconciler's health seam)
+  GET  /healthz      -> liveness (200 while the process can answer at all)
+  GET  /readyz       -> readiness (503 until warmup completes, while
+                        draining, and after the TPU watchdog trips)
+  POST /drain        -> stop admitting, finish in-flight, then exit cleanly
   GET  /v1/stats     -> slots/queue/throughput counters
   POST /v1/generate  -> {"promptTokens": [...] | "prompt": "text",
-                         "maxNewTokens": N, "temperature": T, ...}
+                         "maxNewTokens": N, "temperature": T,
+                         "deadlineS": D, ...}
                         => {"tokens": [...], "text": "..."}
+
+Resilience: admission is bounded (``--max-pending`` -> 429 + Retry-After),
+requests carry deadlines (``--deadline-s`` default, per-request
+``deadlineS``), and a TPU watchdog (KUKEON_WATCHDOG_S) detects a stuck
+engine step, confirms against devices.probe_tpu_runtime, and exits nonzero
+so the runner's restart policy recovers the cell on its own chip grant.
 
 Tokenization: checkpoint-less engines (random init, dev/e2e) use a byte
 tokenizer (id = byte + 1); real deployments pass a HF tokenizer name.
@@ -27,8 +38,105 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from kukeon_tpu import faults
+from kukeon_tpu.serving.engine import DeadlineExceeded, RejectedError
+
 MODELS = {}
 EMBEDDING_MODELS = {}
+
+# Exit code for a watchdog-confirmed wedged TPU runtime: nonzero so the
+# runner's restart policy (always / on-failure) restarts the cell, distinct
+# from generic crashes so the operator can grep for it in `kuke get` reasons.
+WEDGED_EXIT_CODE = 86
+
+DRAIN_TIMEOUT_ENV = "KUKEON_DRAIN_TIMEOUT_S"
+WATCHDOG_ENV = "KUKEON_WATCHDOG_S"
+WATCHDOG_PROBE_TIMEOUT_ENV = "KUKEON_WATCHDOG_PROBE_TIMEOUT_S"
+
+
+class LifecycleMixin:
+    """Readiness/drain lifecycle shared by both cell flavors.
+
+    States: warming up (unready) -> ready -> draining (unready, in-flight
+    finishing) -> drained. The watchdog flips unready via mark_unready
+    before exiting. Everything here is advisory for direct (non-HTTP) cell
+    use; the HTTP handler is where admission is enforced.
+    """
+
+    def _init_lifecycle(self):
+        self._ready = threading.Event()
+        self.unready_reason: str | None = "warming up"
+        self.draining = False
+        self.drained = threading.Event()
+        self._drain_lock = threading.Lock()
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        # main() points this at server.shutdown so a finished drain unblocks
+        # serve_forever and the process exits 0.
+        self.on_drained = None
+
+    def mark_ready(self):
+        self.unready_reason = None
+        self._ready.set()
+
+    def mark_unready(self, reason: str):
+        self.unready_reason = reason
+        self._ready.clear()
+
+    def readiness(self) -> tuple[bool, str | None]:
+        if self.draining:
+            return False, "draining"
+        if not self._ready.is_set():
+            return False, self.unready_reason or "not ready"
+        return True, None
+
+    def check_admission(self):
+        """Raise RejectedError while the cell must not take new requests.
+        Queue-full shedding lives in the engine; this is the lifecycle
+        layer (warming up / draining / watchdog-tripped)."""
+        ok, why = self.readiness()
+        if not ok:
+            raise RejectedError(f"not admitting requests: {why}",
+                                retry_after_s=5.0)
+
+    def _inflight_inc(self):
+        with self._inflight_lock:
+            self._inflight += 1
+
+    def _inflight_dec(self):
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    def _idle(self) -> bool:
+        """No in-flight HTTP requests (subclasses add engine occupancy)."""
+        with self._inflight_lock:
+            return self._inflight == 0
+
+    def begin_drain(self) -> bool:
+        """Stop admitting, finish in-flight work, then report drained (and
+        fire on_drained, which in main() shuts the HTTP server down).
+        Idempotent; returns False if a drain was already running."""
+        with self._drain_lock:
+            if self.draining:
+                return False
+            self.draining = True
+        self.mark_unready("draining")
+        threading.Thread(target=self._drain_loop, daemon=True,
+                         name="cell-drain").start()
+        return True
+
+    def _drain_loop(self):
+        timeout = float(os.environ.get(DRAIN_TIMEOUT_ENV, "30") or 30)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and not self._idle():
+            time.sleep(0.05)
+        self._shutdown_engine()
+        self.drained.set()
+        if self.on_drained is not None:
+            self.on_drained()
+
+    def _shutdown_engine(self):
+        pass
 
 
 def _trailing_fffd(s: str) -> int:
@@ -116,11 +224,13 @@ def _register_models():
     })
 
 
-class ServingCell:
+class ServingCell(LifecycleMixin):
     def __init__(self, model: str, *, num_slots: int, max_seq_len: int | None,
                  checkpoint: str | None, dtype: str | None, seed: int = 0,
                  kv_cache_int8: bool | None = None,
-                 decode_chunk: int | None = None):
+                 decode_chunk: int | None = None,
+                 max_pending: int | None = None,
+                 deadline_s: float | None = None):
         import jax
 
         _enable_compilation_cache()
@@ -212,6 +322,7 @@ class ServingCell:
             kv_cache_int8=kv_cache_int8, async_load=True,
             forward_fn=forward_fn, param_specs=param_specs,
             decode_chunk=decode_chunk, model_name=model,
+            max_pending=max_pending,
         )
         from kukeon_tpu.serving.tokenizer import load_tokenizer
 
@@ -219,6 +330,9 @@ class ServingCell:
         self.started_at = time.time()
         self.total_tokens = 0
         self._stats_lock = threading.Lock()
+        # Default per-request deadline; a request's own deadlineS wins.
+        self.default_deadline_s = deadline_s
+        self._init_lifecycle()
 
     @staticmethod
     def _load_checkpoint(path: str, cfg, quantize: bool = False):
@@ -285,7 +399,12 @@ class ServingCell:
         prefix_id = req.get("prefixId")
         if prefix_id is not None and not isinstance(prefix_id, str):
             raise ValueError("prefixId must be a string")
-        return prompt, sp, list(stops), prefix_id
+        deadline_s = req.get("deadlineS", self.default_deadline_s)
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+            if deadline_s <= 0:
+                raise ValueError("deadlineS must be positive")
+        return prompt, sp, list(stops), prefix_id, deadline_s
 
     def generate(self, req: dict) -> dict:
         """Non-streaming generation: the terminal record of the streaming
@@ -293,6 +412,8 @@ class ServingCell:
         out = None
         for out in self.generate_stream(req):
             pass
+        if out.get("timedOut"):
+            raise DeadlineExceeded(out["error"])
         if "error" in out:
             raise RuntimeError(out["error"])
         return {k: out[k] for k in ("tokens", "text", "numTokens", "seconds")}
@@ -309,12 +430,12 @@ class ServingCell:
         inside the engine."""
         import queue as _q
 
-        prompt, sp, stops, prefix_id = self._parse_generate(req)
+        prompt, sp, stops, prefix_id, deadline_s = self._parse_generate(req)
         events: _q.Queue = _q.Queue()
         t0 = time.monotonic()
         r = self.engine.submit(prompt, sp,
                                emit=lambda tok, done: events.put((tok, done)),
-                               prefix_id=prefix_id)
+                               prefix_id=prefix_id, deadline_s=deadline_s)
         driving = not self.engine._running   # direct use without the thread
         tokens: list[int] = []
         emitted = ""
@@ -360,6 +481,14 @@ class ServingCell:
                     yield {"token": tok, "text": delta}
             if done:
                 break
+        if r.timed_out:
+            # In-band timeout terminal event: the deadline expiring mid-
+            # stream must not masquerade as a transport error — partial
+            # tokens are already on the wire, the terminal line names why
+            # they stopped.
+            yield {"error": f"deadline exceeded: {r.error}",
+                   "timedOut": True, "numTokens": len(tokens)}
+            return
         if r.error is not None:
             yield {"error": f"{type(r.error).__name__}: {r.error}"}
             return
@@ -376,9 +505,20 @@ class ServingCell:
             "stopped": stopped,
         }
 
+    def _idle(self) -> bool:
+        # _requests is the engine's authoritative unfinished-request map —
+        # it covers queued, slotted, AND mid-dispatch requests (queue depth
+        # + free-slot counts have a window during prefill dispatch where
+        # both read idle while a request is in flight).
+        return super()._idle() and not self.engine._requests
+
+    def _shutdown_engine(self):
+        self.engine.stop()
+
     def stats(self) -> dict:
         import jax
 
+        ready, unready_why = self.readiness()
         return {
             "model": self.model_name,
             "devices": [str(d) for d in jax.devices()],
@@ -394,10 +534,20 @@ class ServingCell:
                 "kvCacheInt8": self.engine.kv_cache_int8,
                 "fromProfile": self.engine.tune is not None,
             },
+            # Overload/lifecycle counters (the shed accounting the stress
+            # tier asserts on): queueDepth is live, rejected/timedOut are
+            # monotonic totals since boot.
+            "queueDepth": self.engine.queue_depth,
+            "maxPending": self.engine.max_pending,
+            "rejected": self.engine.shed_stats["rejected"],
+            "timedOut": self.engine.shed_stats["timed_out"],
+            "ready": ready,
+            "draining": self.draining,
+            **({"unreadyReason": unready_why} if unready_why else {}),
         }
 
 
-class EmbeddingCell:
+class EmbeddingCell(LifecycleMixin):
     """Embedding-model serving cell (bge-base): /v1/embed instead of
     /v1/generate; same health/stats seams as the decoder cell so the
     reconciler treats both cell flavors identically."""
@@ -442,6 +592,7 @@ class EmbeddingCell:
         self.started_at = time.time()
         self.total_sequences = 0
         self._stats_lock = threading.Lock()
+        self._init_lifecycle()
 
     @staticmethod
     def _load_checkpoint(path: str, cfg):
@@ -494,28 +645,117 @@ class EmbeddingCell:
         }
 
 
+class EngineWatchdog(threading.Thread):
+    """Detects a wedged TPU runtime behind a stuck engine and gets the cell
+    restarted instead of hanging forever.
+
+    Failure mode (STATUS.md r4/r5): a wedged libtpu/tunnel accepts work and
+    then blocks a device call indefinitely — the engine driver thread is
+    stuck inside jit dispatch, no Python-level timeout fires, and the cell
+    sits Ready while serving nobody. The watchdog watches the engine's
+    progress heartbeat; once work has been outstanding with no progress past
+    ``stall_budget_s`` it consults ``devices.probe_tpu_runtime`` (a killable
+    subprocess probe, so it works even while this process's own runtime is
+    stuck). A "wedged" verdict trips the watchdog: ``on_wedged`` runs (the
+    cell flips unready and exits WEDGED_EXIT_CODE) and the runner's restart
+    policy + stable chip grant bring the cell back on its own chips. Any
+    other verdict re-arms the budget — a long compile or a giant prefill is
+    slow, not wedged, and must not get the cell killed.
+    """
+
+    def __init__(self, engine, *, stall_budget_s: float,
+                 probe=None, on_wedged=None, interval_s: float | None = None,
+                 probe_timeout_s: float = 20.0):
+        super().__init__(daemon=True, name="tpu-watchdog")
+        self.engine = engine
+        self.stall_budget_s = stall_budget_s
+        self.probe = probe
+        self.on_wedged = on_wedged
+        self.interval_s = interval_s if interval_s is not None else max(
+            0.5, stall_budget_s / 4)
+        self.probe_timeout_s = probe_timeout_s
+        self.tripped = False
+        self.last_verdict: tuple[str, str] | None = None
+        self.probes = 0
+        self._halt = threading.Event()
+
+    def stop(self):
+        self._halt.set()
+
+    def run(self):
+        probe = self.probe
+        if probe is None:
+            from kukeon_tpu.runtime.devices import probe_tpu_runtime
+            probe = probe_tpu_runtime
+        while not self._halt.wait(self.interval_s):
+            if self.engine.stalled_s() < self.stall_budget_s:
+                continue
+            self.probes += 1
+            status, detail = probe(timeout_s=self.probe_timeout_s)
+            self.last_verdict = (status, detail)
+            if status == "wedged":
+                self.tripped = True
+                if self.on_wedged is not None:
+                    self.on_wedged(detail)
+                return
+            # Runtime answers: the stall is compute- or host-side. Treat the
+            # probe completion as progress so the next probe waits a full
+            # budget (no probe hammering during a legitimately long step).
+            self.engine.last_progress = time.monotonic()
+
+
 def make_handler(cell: ServingCell):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *a):
             sys.stderr.write("serving-cell: " + fmt % a + "\n")
 
-        def _send(self, code: int, obj: dict):
+        def _send(self, code: int, obj: dict,
+                  headers: dict[str, str] | None = None):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
+        def _reject(self, e: RejectedError):
+            """429 (engine queue full — retry against THIS cell) or 503
+            (lifecycle: warming up/draining/wedged — retry elsewhere), both
+            with Retry-After so clients back off instead of hammering."""
+            import math
+
+            ok, _why = (cell.readiness() if hasattr(cell, "readiness")
+                        else (True, None))
+            code = 429 if ok else 503
+            self._send(code, {"error": str(e), "retryAfterSeconds":
+                              e.retry_after_s},
+                       headers={"Retry-After":
+                                str(max(1, math.ceil(e.retry_after_s)))})
+
         def do_GET(self):
-            if self.path == "/v1/health":
+            if self.path == "/v1/health" or self.path == "/healthz":
+                # Liveness: answering at all is the signal.
                 self._send(200, {"status": "ok", "model": cell.model_name})
+            elif self.path == "/readyz":
+                ok, why = (cell.readiness() if hasattr(cell, "readiness")
+                           else (True, None))
+                if ok:
+                    self._send(200, {"ready": True})
+                else:
+                    self._send(503, {"ready": False, "reason": why})
             elif self.path == "/v1/stats":
                 self._send(200, cell.stats())
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            if self.path == "/drain":
+                started = (cell.begin_drain()
+                           if hasattr(cell, "begin_drain") else False)
+                self._send(200, {"draining": True, "started": started})
+                return
             routes = {}
             if hasattr(cell, "generate"):
                 routes["/v1/generate"] = cell.generate
@@ -526,18 +766,34 @@ def make_handler(cell: ServingCell):
                 self._send(404, {"error": f"no route {self.path}; "
                                           f"this cell serves {sorted(routes)}"})
                 return
+            tracked = False
             try:
+                faults.maybe_fail("cell.http")
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
+                # Lifecycle admission first (503), then the engine's own
+                # queue-full shedding fires inside submit (429).
+                if hasattr(cell, "check_admission"):
+                    cell.check_admission()
+                if hasattr(cell, "_inflight_inc"):
+                    cell._inflight_inc()
+                    tracked = True
                 if (self.path == "/v1/generate" and req.get("stream")
                         and hasattr(cell, "generate_stream")):
                     self._stream(cell.generate_stream(req))
                     return
                 self._send(200, fn(req))
+            except RejectedError as e:
+                self._reject(e)
+            except DeadlineExceeded as e:
+                self._send(504, {"error": str(e), "timedOut": True})
             except ValueError as e:
                 self._send(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — server must keep serving
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            finally:
+                if tracked:
+                    cell._inflight_dec()
 
         def _stream(self, gen):
             """Newline-delimited JSON, framed by connection close (the
@@ -547,6 +803,12 @@ def make_handler(cell: ServingCell):
 
             try:
                 first = next(gen)
+            except RejectedError as e:
+                # The engine sheds inside submit(), which runs lazily at the
+                # first pull — headers are not out yet, so the rejection can
+                # still travel as a clean 429/503.
+                self._reject(e)
+                return
             except ValueError as e:
                 self._send(400, {"error": str(e)})
                 return
@@ -591,6 +853,11 @@ def main(argv=None) -> int:
     ap.add_argument("--kv-cache-int8", action="store_true", default=None)
     ap.add_argument("--decode-chunk", type=int, default=None)
     ap.add_argument("--no-warmup", action="store_true")
+    # Admission control: bound the pending queue (shed with 429 past it)
+    # and default every request to a deadline (expired requests free their
+    # slot and answer in-band). 0 disables either.
+    ap.add_argument("--max-pending", type=int, default=64)
+    ap.add_argument("--deadline-s", type=float, default=0.0)
     args = ap.parse_args(argv)
 
     _register_models()
@@ -606,6 +873,8 @@ def main(argv=None) -> int:
             args.model, num_slots=args.num_slots, max_seq_len=args.max_seq_len,
             checkpoint=args.checkpoint, dtype=args.dtype,
             kv_cache_int8=args.kv_cache_int8, decode_chunk=args.decode_chunk,
+            max_pending=args.max_pending or None,
+            deadline_s=args.deadline_s or None,
         )
         # Warmup before the engine thread starts: step() is single-driver.
         if not args.no_warmup:
@@ -627,11 +896,46 @@ def main(argv=None) -> int:
               file=sys.stderr, flush=True)
         cell = build()
     server = ThreadingHTTPServer((args.host, args.port), make_handler(cell))
+    # /readyz goes true only now: weights loaded, warmup done, server bound.
+    cell.on_drained = server.shutdown
+    cell.mark_ready()
+
+    # SIGTERM = drain (the runner's stop path sends it with a grace window):
+    # stop admitting, finish in-flight, exit 0. A second SIGTERM (or the
+    # runner's SIGKILL after the grace) still kills immediately.
+    import signal as _signal
+
+    _signal.signal(_signal.SIGTERM, lambda *_a: cell.begin_drain())
+
+    # TPU watchdog: a stuck engine step past the stall budget, confirmed
+    # wedged by the runtime probe, exits WEDGED_EXIT_CODE so the restart
+    # policy recovers the cell (same chip grant, runner._chip_slices).
+    watchdog = None
+    budget = float(os.environ.get(WATCHDOG_ENV, "120") or 0)
+    if budget > 0 and isinstance(cell, ServingCell):
+
+        def _wedged(detail: str):
+            cell.mark_unready(f"TPU runtime wedged: {detail}")
+            print(f"serving-cell: watchdog tripped — {detail}; exiting "
+                  f"{WEDGED_EXIT_CODE} for restart", file=sys.stderr,
+                  flush=True)
+            os._exit(WEDGED_EXIT_CODE)
+
+        watchdog = EngineWatchdog(
+            cell.engine, stall_budget_s=budget, on_wedged=_wedged,
+            probe_timeout_s=float(
+                os.environ.get(WATCHDOG_PROBE_TIMEOUT_ENV, "20") or 20),
+        )
+        watchdog.start()
+
     print(f"serving-cell: {args.model} ready on {args.host}:{args.port}", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
     return 0
 
 

@@ -1,5 +1,7 @@
 from kukeon_tpu.serving.engine import (  # noqa: F401
+    DeadlineExceeded,
     DecodeState,
+    RejectedError,
     Request,
     ServingEngine,
     bucket_length,

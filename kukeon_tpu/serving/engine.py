@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from kukeon_tpu import faults
 from kukeon_tpu.models import llama
 from kukeon_tpu.parallel import sharding as shd
 from kukeon_tpu.parallel.mesh import set_mesh
@@ -56,6 +57,22 @@ from kukeon_tpu.serving.sampling import (
 )
 
 PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096)
+
+
+class RejectedError(RuntimeError):
+    """Request shed by admission control (queue full, draining, or unready).
+
+    Carries ``retry_after_s`` so HTTP front-ends can answer 429/503 with a
+    concrete ``Retry-After`` instead of inviting an immediate retry storm.
+    """
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(RuntimeError):
+    """A request's deadline passed before it finished generating."""
 
 
 @jax.tree_util.register_dataclass
@@ -84,6 +101,11 @@ class Request:
     first_token_at: float = 0.0
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     cancelled: bool = False
+    # Absolute monotonic deadline (None = no deadline). Checked at dequeue
+    # and once per driver iteration (i.e. per decode chunk): an expired
+    # request emits the in-band timeout terminal event and frees its slot.
+    deadline: float | None = None
+    timed_out: bool = False
     # Prefix-cache participation (agent sessions share a system prompt /
     # growing conversation): requests with the same prefix_id reuse the
     # stored prompt KV and prefill only the new suffix.
@@ -160,6 +182,7 @@ class ServingEngine:
         prefix_cache_bytes: int = 2 << 30,
         prefill_buckets: tuple[int, ...] | None = None,
         model_name: str | None = None,
+        max_pending: int | None = None,
     ):
         # Model pluggability: any forward with llama.forward's signature
         # ((params, cfg, tokens, positions, cache) -> (logits, cache')) and
@@ -310,6 +333,21 @@ class ServingEngine:
         self._running = False
         self._thread: threading.Thread | None = None
         self.error: Exception | None = None   # last engine-loop failure
+        # Admission control: with max_pending set, submit() sheds (raises
+        # RejectedError) once that many requests are queued but not yet
+        # slotted — bounded memory and bounded queueing delay instead of an
+        # unbounded backlog that OOMs or serves nobody within deadline.
+        # _pending_n is the exact count of admitted-not-yet-slotted requests
+        # (queue.qsize() is wrong during the sweep's drain-and-refill).
+        self.max_pending = max_pending
+        self._pending_n = 0
+        self.retry_after_s = 1.0
+        self.shed_stats = {"rejected": 0, "timed_out": 0}
+        # Progress heartbeat for the TPU watchdog: bumped on submit and on
+        # every step() that did work. A wedged runtime blocks the driver
+        # inside a device call, so this goes stale while work is queued —
+        # exactly the signal stalled_s() exposes.
+        self.last_progress = time.monotonic()
 
         # Prefix cache: prefix_id -> stored prompt KV (LRU, driver-thread
         # only). Agent sessions re-send a large shared/growing context with
@@ -505,11 +543,13 @@ class ServingEngine:
     def _fetch(self, x) -> np.ndarray:
         """Blocking device→host readback, counted (the roofline budget is
         ≤1 per decode chunk — tests/test_serving.py asserts it here)."""
+        faults.maybe_fail("engine.fetch")
         self.sync_stats["fetches"] += 1
         return np.asarray(x)
 
     def _upload(self, x):
         """Host→device array upload, counted."""
+        faults.maybe_fail("engine.upload")
         self.sync_stats["uploads"] += 1
         return jnp.asarray(x)
 
@@ -600,6 +640,7 @@ class ServingEngine:
         sampling: SamplingParams | None = None,
         emit: Callable[[int, bool], None] | None = None,
         prefix_id: str | None = None,
+        deadline_s: float | None = None,
     ) -> Request:
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
@@ -608,17 +649,45 @@ class ServingEngine:
             raise ValueError(
                 f"prompt length {prompt.size} >= engine max_seq_len {self.max_seq_len}"
             )
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be positive")
+        now = time.monotonic()
         with self._lock:
+            if (self.max_pending is not None
+                    and self._pending_n >= self.max_pending):
+                self.shed_stats["rejected"] += 1
+                raise RejectedError(
+                    f"pending queue full ({self._pending_n}/"
+                    f"{self.max_pending}); shedding load",
+                    retry_after_s=self.retry_after_s,
+                )
             req = Request(
                 id=self._next_id, prompt=prompt,
                 sampling=sampling or SamplingParams(),
-                emit=emit, submitted_at=time.monotonic(),
+                emit=emit, submitted_at=now,
                 prefix_id=prefix_id,
+                deadline=(now + deadline_s) if deadline_s is not None else None,
             )
             self._next_id += 1
             self._requests[req.id] = req
+            self._pending_n += 1
+            self.last_progress = now
         self._pending.put(req)
         return req
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests admitted but not yet slotted (the shed threshold)."""
+        return self._pending_n
+
+    def stalled_s(self) -> float:
+        """Seconds since the engine last made progress WHILE work is
+        outstanding; 0.0 when idle (an idle engine is never stalled)."""
+        if self._pending_n == 0 and not any(
+            r is not None for r in self._slot_req
+        ):
+            return 0.0
+        return max(0.0, time.monotonic() - self.last_progress)
 
     def generate(self, prompt, sampling: SamplingParams | None = None) -> list[int]:
         """Blocking convenience wrapper: submit + drive until done."""
@@ -702,43 +771,68 @@ class ServingEngine:
                     self._running = False
                     raise
 
+    def _fail_request(self, req: Request, exc: Exception) -> None:
+        """Fail ONE request (terminal emit + done), tolerating a bad sink."""
+        req.error = exc
+        with self._lock:
+            self._requests.pop(req.id, None)
+        if req.emit:
+            try:
+                req.emit(-1, True)
+            except Exception:  # noqa: BLE001 — a bad sink must not stop the sweep
+                pass
+        req.done.set()
+
     def _fail_all(self, exc: Exception):
         """Fail every active + pending request so callers don't hang.
 
         Streaming consumers block on their emit channel, not on ``done`` —
         each one must receive the terminal (-1, True) event or it waits
         forever (same contract as the cancel paths)."""
-
-        def finish(req: Request):
-            req.error = exc
-            if req.emit:
-                try:
-                    req.emit(-1, True)
-                except Exception:  # noqa: BLE001 — a bad sink must not stop the sweep
-                    pass
-            req.done.set()
-
         for slot, req in list(self._active_requests()):
             self._slot_req[slot] = None
-            finish(req)
+            self._fail_request(req, exc)
         self._sampling_dirty = True
         while True:
             try:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 break
-            finish(req)
+            with self._lock:
+                self._pending_n -= 1
+            self._fail_request(req, exc)
 
     # --- engine core -------------------------------------------------------
 
+    def _expired(self, req: Request, now: float | None = None) -> bool:
+        return (req.deadline is not None
+                and (now if now is not None else time.monotonic())
+                >= req.deadline)
+
     def _sweep_cancelled(self) -> bool:
-        """Driver-thread cancellation: release active cancelled slots and
-        complete queued cancelled requests NOW — a queued cancel must not
-        wait for a slot to free before its waiter wakes."""
+        """Driver-thread cancellation + deadline expiry: release active
+        cancelled/expired slots and complete queued ones NOW — a queued
+        cancel (or an already-expired request) must not wait for a slot to
+        free before its waiter wakes. Runs once per step, i.e. once per
+        decode chunk — that is the deadline-check granularity for active
+        requests."""
         did = False
+        now = time.monotonic()
         for _slot, req in self._active_requests():
-            if req.cancelled and not req.done.is_set():
+            if req.done.is_set():
+                continue
+            if req.cancelled:
                 self._release_slot(req, cancelled=True)
+                did = True
+            elif self._expired(req, now):
+                self.shed_stats["timed_out"] += 1
+                req.timed_out = True
+                req.error = DeadlineExceeded(
+                    f"request {req.id} deadline exceeded after "
+                    f"{now - req.submitted_at:.2f}s "
+                    f"({len(req.generated)} tokens generated)"
+                )
+                self._release_slot(req, timed_out=True)
                 did = True
         # Drain-and-refill: Queue supports no removal. Concurrent submits
         # during the refill just land behind the kept entries.
@@ -751,6 +845,9 @@ class ServingEngine:
             if req.cancelled:
                 self._finish_cancelled(req)
                 did = True
+            elif self._expired(req, now):
+                self._finish_timeout(req)
+                did = True
             else:
                 kept.append(req)
         for req in kept:
@@ -761,6 +858,23 @@ class ServingEngine:
         """Complete a never-started cancelled request (no slot involved)."""
         with self._lock:
             self._requests.pop(req.id, None)
+            self._pending_n -= 1
+        if req.emit:
+            req.emit(-1, True)
+        req.done.set()
+
+    def _finish_timeout(self, req: Request) -> None:
+        """Complete a never-started request whose deadline already passed:
+        in-band timeout terminal event, no slot ever consumed."""
+        with self._lock:
+            self._requests.pop(req.id, None)
+            self._pending_n -= 1
+        self.shed_stats["timed_out"] += 1
+        req.timed_out = True
+        req.error = DeadlineExceeded(
+            f"request {req.id} deadline exceeded while queued "
+            f"({time.monotonic() - req.submitted_at:.2f}s in queue)"
+        )
         if req.emit:
             req.emit(-1, True)
         req.done.set()
@@ -788,8 +902,8 @@ class ServingEngine:
         prefills = []
         for slot in self._free_slots():
             # Pop until a live request: a burst of queued-then-cancelled
-            # requests (client disconnects) must not cost this free slot a
-            # step each.
+            # (client disconnects) or already-expired requests must not cost
+            # this free slot a step each.
             req = None
             while req is None:
                 try:
@@ -800,9 +914,22 @@ class ServingEngine:
                     self._finish_cancelled(req)
                     did_work = True
                     req = None
+                elif self._expired(req):
+                    self._finish_timeout(req)
+                    did_work = True
+                    req = None
             if req is None:
                 break
-            prefills.append(self._dispatch_prefill(req, slot))
+            with self._lock:
+                self._pending_n -= 1   # leaving the queue for a slot
+            try:
+                prefills.append(self._dispatch_prefill(req, slot))
+            except Exception as e:
+                # The request is out of the queue but not yet slotted: fail
+                # it HERE or nobody ever wakes its waiter (_fail_all only
+                # sees slots and the queue).
+                self._fail_request(req, e)
+                raise
             did_work = True
 
         new_inflight = None
@@ -823,6 +950,8 @@ class ServingEngine:
             self._flush_inflight()
             did_work = True
         self._inflight = new_inflight
+        if did_work:
+            self.last_progress = time.monotonic()
         return did_work
 
     def _prefix_lookup(self, req: Request) -> "_CachedPrefix | None":
@@ -868,6 +997,7 @@ class ServingEngine:
         the model (an agent session's shared context prefills once); the
         resulting prompt KV is (re)stored under the request's prefix_id
         either way."""
+        faults.maybe_fail("engine.prefill")
         n = req.prompt.size
         sp = req.sampling
         cached = self._prefix_lookup(req)
@@ -946,6 +1076,7 @@ class ServingEngine:
         return self._sampling_dev
 
     def _dispatch_decode_chunk(self) -> _InflightChunk:
+        faults.maybe_fail("engine.decode")
         k = self._chunk_size()
         temps_d, top_ks_d, top_ps_d = self._sampling_dev_arrays()
         with set_mesh(self.mesh):
@@ -994,7 +1125,8 @@ class ServingEngine:
         if finished:
             self._release_slot(req)
 
-    def _release_slot(self, req: Request, cancelled: bool = False):
+    def _release_slot(self, req: Request, cancelled: bool = False,
+                      timed_out: bool = False):
         slot = req.slot
         self._slot_req[slot] = None
         self._sampling_dirty = True
@@ -1005,8 +1137,9 @@ class ServingEngine:
         )
         with self._lock:
             self._requests.pop(req.id, None)
-        if cancelled and req.emit:
+        if (cancelled or timed_out) and req.emit:
             # Streaming consumers need a terminal event on their channel;
-            # cancellation produces no token, so the sentinel is (-1, True).
+            # cancellation/expiry produces no token, so the sentinel is
+            # (-1, True) — the timeout itself travels on req.timed_out.
             req.emit(-1, True)
         req.done.set()

@@ -9,24 +9,47 @@ and resuming on a different mesh layout reshards transparently because the
 abstract target carries the new NamedShardings.
 
 Layout: ``<root>/step_00000042/`` per checkpoint, newest wins for resume.
-Writes go through orbax's atomic-rename protocol, so a killed writer never
-leaves a checkpoint that :func:`latest_step` would pick up.
+Saves are crash-atomic at THIS layer, belt and suspenders over whatever the
+orbax version does internally: orbax writes into a temp-named directory in
+the same root, the directory entries are fsynced, and only then does a
+single ``os.replace`` publish the final ``step_*`` name. A writer killed at
+any point (fault seam ``checkpoint.save``) leaves at most a temp directory
+that :func:`latest_step` never matches — the previous checkpoint stays the
+resume target, never a truncated one.
 """
 
 from __future__ import annotations
 
 import os
 import re
+import shutil
 
 import jax
 
+from kukeon_tpu import faults
 from kukeon_tpu.training.train_step import TrainState
 
 _STEP_RE = re.compile(r"^step_(\d{8})$")
+_TMP_PREFIX = "tmp-"
 
 
 def _step_dir(root: str, step: int) -> str:
     return os.path.join(root, f"step_{step:08d}")
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory's entries (durability for the rename protocol);
+    best-effort on filesystems that reject directory fsync."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def latest_step(root: str) -> int | None:
@@ -48,7 +71,13 @@ def save_checkpoint(root: str, state: TrainState) -> str:
     """Write ``state`` as ``<root>/step_<state.step>``; returns the path.
     Idempotent per step: a completed checkpoint for this exact step is
     left as-is (a save-every boundary coinciding with the final save must
-    not error)."""
+    not error).
+
+    Crash-atomic: the full checkpoint lands under a temp name in the same
+    directory first; the final name appears via one ``os.replace`` after
+    fsync. A kill anywhere before the replace leaves the previous
+    checkpoint as the newest complete one (tests interrupt the save via
+    the ``checkpoint.save`` fault point to pin this)."""
     import orbax.checkpoint as ocp
 
     step = int(state.step)
@@ -56,9 +85,24 @@ def save_checkpoint(root: str, state: TrainState) -> str:
     if os.path.isdir(path):
         return path
     os.makedirs(root, exist_ok=True)
+    # Same-directory temp name: os.replace must stay a same-filesystem
+    # rename. PID-suffixed so a dead writer's leftovers never collide with
+    # a live retry; stale temps from previous crashes are swept here.
+    tmp = os.path.join(root, f"{_TMP_PREFIX}step_{step:08d}.{os.getpid()}")
+    shutil.rmtree(tmp, ignore_errors=True)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, state)
-    ckptr.wait_until_finished()
+    try:
+        ckptr.save(tmp, state)
+        ckptr.wait_until_finished()
+        # The injected mid-save kill: everything is written under the temp
+        # name, nothing published yet — exactly what a SIGKILL here does.
+        faults.maybe_fail("checkpoint.save")
+        _fsync_dir(tmp)
+        os.replace(tmp, path)
+        _fsync_dir(root)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
     return path
 
 
@@ -79,6 +123,7 @@ def restore_checkpoint(root: str, template: TrainState,
     identical structure (e.g. a freshly created one on the resuming mesh)."""
     import orbax.checkpoint as ocp
 
+    faults.maybe_fail("checkpoint.load")
     if step is None:
         step = latest_step(root)
         if step is None:

@@ -24,3 +24,28 @@ os.environ["XLA_FLAGS"] = (
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers",
+        "faults: tests that arm KUKEON_FAULTS (the fault-injection harness)")
+
+
+@pytest.fixture(autouse=True)
+def _isolate_faults():
+    """Guarantee KUKEON_FAULTS never leaks between tests: an armed fault
+    spec surviving one test would fire random failures in the next. Cleared
+    (and the parsed table + fire counts reset) on both sides of every test;
+    tests arm faults by setting os.environ inside their own body."""
+    from kukeon_tpu import faults
+
+    os.environ.pop(faults.ENV, None)
+    faults.reset()
+    yield
+    os.environ.pop(faults.ENV, None)
+    faults.reset()

@@ -1,0 +1,91 @@
+"""UnixClient transient-dial retry: CLI calls during a daemon restart see
+ENOENT (socket not yet created) or ECONNREFUSED (listener not yet accepting)
+for a moment — the client must ride that out within its budget instead of
+hard-failing, and still fail promptly once the budget is spent."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from kukeon_tpu.runtime.client import UnixClient
+from kukeon_tpu.runtime.errors import Unavailable
+
+
+def _serve_one(path: str, delay_s: float):
+    """After ``delay_s``, bind a one-shot JSON-RPC line server at ``path``."""
+
+    def run():
+        time.sleep(delay_s)
+        srv = socket.socket(socket.AF_UNIX)
+        srv.bind(path)
+        srv.listen(1)
+        conn, _ = srv.accept()
+        f = conn.makefile("rwb")
+        req = json.loads(f.readline())
+        f.write((json.dumps({"id": req["id"], "result": {"pong": True}})
+                 + "\n").encode())
+        f.flush()
+        conn.close()
+        srv.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_dial_rides_out_daemon_restart_window(tmp_path):
+    path = str(tmp_path / "kukeond.sock")
+    assert not os.path.exists(path)          # ENOENT at first dial attempts
+    t = _serve_one(path, delay_s=0.4)
+    c = UnixClient(path, retry_budget_s=3.0)
+    try:
+        assert c.call("Ping") == {"pong": True}
+    finally:
+        c.close()
+        t.join(timeout=5)
+
+
+def test_dial_fails_promptly_past_budget(tmp_path):
+    path = str(tmp_path / "never.sock")
+    c = UnixClient(path, retry_budget_s=0.3)
+    t0 = time.monotonic()
+    with pytest.raises(Unavailable, match="is the daemon running"):
+        c.call("Ping")
+    elapsed = time.monotonic() - t0
+    assert 0.25 <= elapsed < 3.0             # retried through, then gave up
+
+
+def test_connection_refused_is_retried(tmp_path):
+    """A bound-but-dead socket file (daemon crashed) refuses connections;
+    a listener taking over inside the budget gets the call."""
+    path = str(tmp_path / "stale.sock")
+    dead = socket.socket(socket.AF_UNIX)
+    dead.bind(path)
+    dead.close()                             # file exists, nobody listens
+
+    def takeover():
+        time.sleep(0.3)
+        os.unlink(path)
+        srv = socket.socket(socket.AF_UNIX)
+        srv.bind(path)
+        srv.listen(1)
+        conn, _ = srv.accept()
+        f = conn.makefile("rwb")
+        req = json.loads(f.readline())
+        f.write((json.dumps({"id": req["id"], "result": "ok"}) + "\n").encode())
+        f.flush()
+        conn.close()
+        srv.close()
+
+    t = threading.Thread(target=takeover, daemon=True)
+    t.start()
+    c = UnixClient(path, retry_budget_s=3.0)
+    try:
+        assert c.call("Ping") == "ok"
+    finally:
+        c.close()
+        t.join(timeout=5)

@@ -1,0 +1,99 @@
+"""Fault-injection harness: arming syntax, counts, probability, and the
+zero-overhead guarantee when KUKEON_FAULTS is unset."""
+
+import os
+
+import pytest
+
+from kukeon_tpu import faults
+
+
+def test_unarmed_is_a_noop():
+    """The guard contract: with KUKEON_FAULTS unset, maybe_fail builds no
+    table, takes no lock-protected slow path, and never raises — the seams
+    threaded through engine dispatch/transfers stay free in production."""
+    assert os.environ.get(faults.ENV) is None
+    assert not faults.active()
+    for _ in range(1000):
+        faults.maybe_fail("engine.decode")
+    # Nothing parsed, nothing counted: the armed-path state stays empty.
+    assert faults._cached_spec is None
+    assert faults._points == {}
+    assert faults.stats == {}
+
+
+def test_unarmed_is_cheap_relative_to_armed_miss():
+    """The unset path must be a bare env lookup — meaningfully cheaper than
+    even an armed-but-different-point lookup (which pays the lock)."""
+    import timeit
+
+    unarmed = timeit.timeit(
+        lambda: faults.maybe_fail("p"), number=20000)
+    os.environ[faults.ENV] = "other.point:1"
+    try:
+        armed_miss = timeit.timeit(
+            lambda: faults.maybe_fail("p"), number=20000)
+    finally:
+        del os.environ[faults.ENV]
+    assert unarmed < armed_miss
+
+
+@pytest.mark.faults
+def test_always_fires_and_counts():
+    os.environ[faults.ENV] = "engine.decode:1"
+    with pytest.raises(faults.FaultInjected):
+        faults.maybe_fail("engine.decode")
+    with pytest.raises(faults.FaultInjected):
+        faults.maybe_fail("engine.decode")
+    faults.maybe_fail("engine.prefill")   # unarmed point passes
+    assert faults.fired("engine.decode") == 2
+    assert faults.fired("engine.prefill") == 0
+
+
+@pytest.mark.faults
+def test_count_cap_exhausts():
+    os.environ[faults.ENV] = "cell.http:1:2"
+    with pytest.raises(faults.FaultInjected):
+        faults.maybe_fail("cell.http")
+    with pytest.raises(faults.FaultInjected):
+        faults.maybe_fail("cell.http")
+    faults.maybe_fail("cell.http")        # cap reached: passes forever after
+    faults.maybe_fail("cell.http")
+    assert faults.fired("cell.http") == 2
+
+
+@pytest.mark.faults
+def test_multiple_points_and_env_reparse():
+    os.environ[faults.ENV] = "a:1, b:1:1"
+    with pytest.raises(faults.FaultInjected):
+        faults.maybe_fail("a")
+    with pytest.raises(faults.FaultInjected):
+        faults.maybe_fail("b")
+    faults.maybe_fail("b")                # b exhausted
+    # Re-arming with a different spec takes effect immediately (no reset).
+    os.environ[faults.ENV] = "c:1"
+    faults.maybe_fail("a")
+    with pytest.raises(faults.FaultInjected):
+        faults.maybe_fail("c")
+
+
+@pytest.mark.faults
+def test_probability_zero_never_fires():
+    os.environ[faults.ENV] = "p:0"
+    for _ in range(200):
+        faults.maybe_fail("p")
+    assert faults.fired("p") == 0
+
+
+@pytest.mark.faults
+def test_custom_exception_and_message():
+    os.environ[faults.ENV] = "io:1"
+    with pytest.raises(OSError, match="disk gone"):
+        faults.maybe_fail("io", exc=OSError, msg="disk gone")
+
+
+@pytest.mark.faults
+def test_bad_spec_fails_loudly():
+    os.environ[faults.ENV] = "point:not-a-prob"
+    with pytest.raises(ValueError):
+        faults.maybe_fail("point")

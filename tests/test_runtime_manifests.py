@@ -225,6 +225,7 @@ def test_stream_deltas_survive_split_utf8_codepoint():
             self.done = threading.Event()
             self.error = None
             self.cancelled = False
+            self.timed_out = False
 
         def cancel(self):
             self.cancelled = True
@@ -232,7 +233,8 @@ def test_stream_deltas_survive_split_utf8_codepoint():
     class FakeEngine:
         _running = True   # consumer loop reads straight off the queue
 
-        def submit(self, prompt, sp, emit=None, prefix_id=None):
+        def submit(self, prompt, sp, emit=None, prefix_id=None,
+                   deadline_s=None):
             r = FakeReq()
             for i, tok in enumerate(script):
                 emit(tok, i == len(script) - 1)

@@ -13,7 +13,7 @@ import numpy as np
 
 from kukeon_tpu.models import llama
 from kukeon_tpu.parallel import make_mesh
-from kukeon_tpu.serving import SamplingParams, ServingEngine
+from kukeon_tpu.serving import RejectedError, SamplingParams, ServingEngine
 
 
 def test_many_requests_few_slots_background_loop():
@@ -132,6 +132,74 @@ def test_cancel_frees_slot_and_wakes_waiter():
     assert ghost.generated == []            # never ran
     assert len(eng._free_slots()) == eng.num_slots
     assert not eng._requests                # no leaked request records
+
+
+def test_overload_sheds_and_nothing_hangs():
+    """Flood far past max_pending: every submit either completes, sheds
+    with RejectedError, or times out on its deadline — and the shed/timeout
+    accounting in /v1/stats adds up. No request may hang forever (the
+    fair-weather failure this layer exists to remove)."""
+    import http.client
+    import json
+    import time as _time
+    from http.server import ThreadingHTTPServer
+
+    from kukeon_tpu.runtime.serving_cell import ServingCell, make_handler
+
+    cell = ServingCell("tiny", num_slots=2, max_seq_len=96, checkpoint=None,
+                       dtype=None, max_pending=4)
+    eng = cell.engine
+    eng.start()
+    cell.mark_ready()
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(cell))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        prompt = np.arange(1, 9, dtype=np.int32)
+        accepted = []
+        rejected = 0
+        # Tight flood: submits are far faster than the driver can slot, so
+        # the bound MUST shed some of these.
+        for i in range(30):
+            # A few requests carry a deadline that will already have passed
+            # when their turn comes -> counted as timed_out, still terminal.
+            dl = 0.001 if i % 7 == 3 else 30.0
+            try:
+                accepted.append(eng.submit(
+                    prompt, SamplingParams(temperature=0.0, max_new_tokens=3),
+                    deadline_s=dl))
+            except RejectedError:
+                rejected += 1
+        assert rejected > 0, "flood past max_pending did not shed"
+        assert rejected + len(accepted) == 30
+
+        deadline = _time.monotonic() + 120
+        for r in accepted:
+            assert r.done.wait(timeout=max(0.0, deadline - _time.monotonic())), \
+                "an admitted request hung forever"
+        timed_out = sum(1 for r in accepted if r.timed_out)
+        completed = sum(1 for r in accepted
+                        if r.error is None and not r.cancelled)
+        assert timed_out + completed == len(accepted)
+        for r in accepted:
+            if r.error is None:
+                assert len(r.generated) == 3
+
+        # The counters the operator sees must match what actually happened.
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          server.server_address[1], timeout=30)
+        conn.request("GET", "/v1/stats")
+        stats = json.loads(conn.getresponse().read())
+        conn.close()
+        assert stats["rejected"] == rejected
+        assert stats["timedOut"] == timed_out
+        assert stats["queueDepth"] == 0          # backlog fully drained
+        assert eng.queue_depth == 0
+        assert len(eng._free_slots()) == eng.num_slots
+        assert not eng._requests
+    finally:
+        server.shutdown()
+        server.server_close()
+        eng.stop()
 
 
 def test_queued_cancel_completes_while_slots_stay_busy():

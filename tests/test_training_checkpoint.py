@@ -4,6 +4,7 @@ mesh's layout (incl. a DIFFERENT mesh), training continues bit-identical."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kukeon_tpu.models import llama
 from kukeon_tpu.parallel import make_mesh, set_mesh
@@ -84,3 +85,46 @@ def test_latest_step_empty_and_missing(tmp_path):
     assert latest_step(str(tmp_path / "nope")) is None
     (tmp_path / "c").mkdir()
     assert latest_step(str(tmp_path / "c")) is None
+
+
+@pytest.mark.faults
+def test_interrupted_save_preserves_previous_checkpoint(tmp_path):
+    """A save killed between writing and publishing (fault seam
+    ``checkpoint.save`` = SIGKILL mid-save) must leave the PREVIOUS
+    checkpoint as the newest complete one: latest_step never sees the
+    partial write, restore still succeeds, and a later healthy save of the
+    same step goes through."""
+    import dataclasses
+    import os
+
+    from kukeon_tpu import faults
+
+    cfg = llama.llama_tiny()
+    mesh = make_mesh(tensor=2, data=4)
+    root = str(tmp_path / "ckpts")
+    with set_mesh(mesh):
+        opt = make_optimizer(warmup_steps=1, total_steps=10)
+        state, opt = create_train_state(cfg, mesh, jax.random.key(0), opt)
+        save_checkpoint(root, state)                    # step 0: the survivor
+        assert latest_step(root) == 0
+        want = [np.asarray(x) for x in jax.tree.leaves(state.params)]
+
+        bumped = dataclasses.replace(state, step=state.step + 1)
+        os.environ[faults.ENV] = "checkpoint.save:1:1"
+        with pytest.raises(faults.FaultInjected):
+            save_checkpoint(root, bumped)               # killed mid-save
+
+        # The interrupted write published nothing and left no debris that
+        # a resume would mistake for a checkpoint.
+        assert latest_step(root) == 0
+        assert sorted(os.listdir(root)) == ["step_00000000"]
+
+        restored = restore_checkpoint(root, state)
+        assert int(restored.step) == 0
+        got = [np.asarray(x) for x in jax.tree.leaves(restored.params)]
+        for a, b in zip(got, want):
+            np.testing.assert_array_equal(a, b)
+
+        # Fault exhausted (count=1): the retried save completes and wins.
+        save_checkpoint(root, bumped)
+        assert latest_step(root) == 1
