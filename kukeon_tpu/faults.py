@@ -42,6 +42,23 @@ import threading
 
 ENV = "KUKEON_FAULTS"
 
+# Every fault point threaded through the codebase, declared here so the
+# observability layer can expose a ``kukeon_faults_fired_total{point=...}``
+# sample for each one (zero when never fired) and the guard test in
+# tests/test_obs.py can grep call sites against this list — a new
+# ``maybe_fail("x.y")`` that is not declared here fails CI, so fault
+# points can't ship unobservable.
+POINTS = (
+    "engine.prefill",
+    "engine.decode",
+    "engine.fetch",
+    "engine.upload",
+    "cell.http",
+    "checkpoint.save",
+    "checkpoint.load",
+    "devices.probe_wedged",
+)
+
 
 class FaultInjected(RuntimeError):
     """Raised by an armed fault point (the injected failure)."""

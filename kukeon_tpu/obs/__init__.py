@@ -1,0 +1,27 @@
+"""Unified observability layer: metrics registry, Prometheus exposition,
+and per-request trace spans.
+
+Zero-dependency by design (the container bakes no prometheus_client): the
+registry is a few hundred lines of locked dicts, the exposition is the
+Prometheus text format 0.0.4 by hand, and traces are dataclasses in a ring
+buffer. Everything the serving engine, the cells, the runner, and the
+daemon report flows through here; ``bench.py`` scores itself from the same
+histograms a production scrape would read.
+
+Naming convention: ``kukeon_<subsystem>_<name>`` with ``_total`` for
+counters and ``_seconds`` for latency histograms — e.g.
+``kukeon_engine_ttft_seconds``, ``kukeon_runner_cell_restarts_total``,
+``kukeon_faults_fired_total{point="engine.decode"}``.
+"""
+
+from kukeon_tpu.obs.registry import (  # noqa: F401
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    get_default,
+    percentile_from_counts,
+)
+from kukeon_tpu.obs.expo import faults_collector, render  # noqa: F401
+from kukeon_tpu.obs.trace import Span, Tracer  # noqa: F401

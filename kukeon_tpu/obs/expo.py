@@ -1,0 +1,101 @@
+"""Prometheus text exposition (format 0.0.4) over an obs Registry.
+
+Hand-rolled because the container bakes no prometheus_client; the golden
+test in tests/test_obs.py parses this output with its own strict parser,
+so the format here is pinned by test, not by hope. Histograms emit the
+conventional cumulative ``_bucket{le=...}`` series (always ending in
+``le="+Inf"``) plus ``_sum``/``_count``.
+"""
+
+from __future__ import annotations
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _labels_str(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(str(v))}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_le(b: float) -> str:
+    return ("%.10g" % b)
+
+
+def render(registry) -> str:
+    """The full exposition for one registry: declared metrics first
+    (sorted by name), then every registered collector's families."""
+    out: list[str] = []
+    for m in registry.metrics():
+        out.append(f"# HELP {m.name} {m.help}".rstrip())
+        out.append(f"# TYPE {m.name} {m.kind}")
+        if m.kind == "histogram":
+            _render_histogram(out, m)
+            continue
+        for labels, value in sorted(
+            m.samples(), key=lambda s: sorted(s[0].items())
+        ):
+            out.append(f"{m.name}{_labels_str(labels)} {_fmt(value)}")
+    for fn in registry.collectors():
+        for name, kind, help, samples in fn():
+            out.append(f"# HELP {name} {help}".rstrip())
+            out.append(f"# TYPE {name} {kind}")
+            for labels, value in samples:
+                out.append(f"{name}{_labels_str(labels)} {_fmt(value)}")
+    return "\n".join(out) + "\n"
+
+
+def _render_histogram(out: list[str], h) -> None:
+    with h._lock:
+        series = {k: (list(c), s, n) for k, (c, s, n) in h._series.items()}
+    if not series:
+        # An empty histogram still exposes a zero-count labelless series
+        # only when it has no label dimensions (a scraper then sees the
+        # family exists); labelled families stay silent until observed.
+        if not h.label_names:
+            series[()] = ([0] * (len(h.buckets) + 1), 0.0, 0)
+    for key in sorted(series):
+        counts, total, n = series[key]
+        labels = dict(zip(h.label_names, key))
+        cum = 0
+        for b, c in zip(h.buckets, counts[:-1]):
+            cum += c
+            le = dict(labels)
+            le["le"] = _fmt_le(b)
+            out.append(f"{h.name}_bucket{_labels_str(le)} {cum}")
+        le = dict(labels)
+        le["le"] = "+Inf"
+        out.append(f"{h.name}_bucket{_labels_str(le)} {n}")
+        out.append(f"{h.name}_sum{_labels_str(labels)} {_fmt(total)}")
+        out.append(f"{h.name}_count{_labels_str(labels)} {n}")
+
+
+def faults_collector():
+    """Scrape-time family for the fault-injection harness: one
+    ``kukeon_faults_fired_total{point=...}`` sample per declared fault
+    point (zero when never fired), plus any extra point that fired without
+    being declared — the conftest guard test turns that situation into a
+    failure, but the scrape itself must never hide a fire count."""
+    from kukeon_tpu import faults
+
+    points = dict.fromkeys(faults.POINTS, 0)
+    points.update(faults.stats)
+    yield (
+        "kukeon_faults_fired_total", "counter",
+        "Fault-injection fires by point (kukeon_tpu.faults).",
+        [({"point": p}, float(v)) for p, v in sorted(points.items())],
+    )

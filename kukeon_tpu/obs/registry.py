@@ -1,0 +1,281 @@
+"""Thread-safe metrics registry: Counter, Gauge, Histogram.
+
+Design constraints (the serving hot path runs through these):
+
+- **One lock per registry**, taken only for the few dict/float operations
+  of an update. The decode loop's per-chunk instrumentation is a handful
+  of counter bumps; a contended mutex would still be nanoseconds next to a
+  device dispatch, and the hammer test in tests/test_obs.py pins exactness
+  (no torn reads, no lost increments).
+- **Labels are kwargs**, values stringified, keyed by a tuple in declared
+  order. Metric identity is (name); re-asking the registry for an existing
+  name returns the same object (and raises on a type/label mismatch — two
+  subsystems silently sharing a name with different schemas is a bug).
+- **Scrape-time values**: a Gauge can be backed by a callable
+  (``set_function``) so live values like queue depth cost nothing between
+  scrapes; whole families can be produced at collect time via
+  :meth:`Registry.register_collector` (how fault-injection fire counts
+  surface without the faults module importing obs).
+
+Latency histograms share one fixed log-spaced bucket ladder
+(:data:`LATENCY_BUCKETS_S`, 250µs → ~131s, powers of two) so every
+latency metric is cross-comparable and the exposition stays compact.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+# Fixed log-spaced latency ladder: 0.25ms * 2^i, i in [0, 19) -> ~0.25ms,
+# 0.5ms, 1ms, ... 65.5s, 131s. Wide enough for TTFT on a tunneled chip and
+# tight enough at the bottom for inter-token latency.
+LATENCY_BUCKETS_S: tuple[float, ...] = tuple(
+    0.00025 * (2 ** i) for i in range(19)
+)
+
+_LabelKey = tuple[str, ...]
+
+
+def _label_key(label_names: tuple[str, ...], labels: dict) -> _LabelKey:
+    if set(labels) != set(label_names):
+        raise ValueError(
+            f"labels {sorted(labels)} != declared {sorted(label_names)}"
+        )
+    return tuple(str(labels[k]) for k in label_names)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, label_names: tuple[str, ...],
+                 lock: threading.Lock):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._lock = lock
+
+    def samples(self) -> list[tuple[dict[str, str], float]]:
+        """(labels, value) pairs for exposition (flat metrics only)."""
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonically increasing float, optionally labelled."""
+
+    kind = "counter"
+
+    def __init__(self, name, help, label_names, lock):
+        super().__init__(name, help, label_names, lock)
+        self._values: dict[_LabelKey, float] = {}
+        if not self.label_names:
+            self._values[()] = 0.0
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def samples(self):
+        with self._lock:
+            items = list(self._values.items())
+        return [(dict(zip(self.label_names, k)), v) for k, v in items]
+
+
+class Gauge(_Metric):
+    """Point-in-time float; settable, incrementable, or callable-backed."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help, label_names, lock):
+        super().__init__(name, help, label_names, lock)
+        self._values: dict[_LabelKey, float] = {}
+        self._fns: dict[_LabelKey, Callable[[], float]] = {}
+
+    def set(self, value: float, **labels) -> None:
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def set_function(self, fn: Callable[[], float], **labels) -> None:
+        """Back this labelset with a callable evaluated at scrape time —
+        live values (queue depth, uptime) cost nothing between scrapes."""
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            self._fns[key] = fn
+
+    def value(self, **labels) -> float:
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            fn = self._fns.get(key)
+        if fn is not None:
+            return float(fn())
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def samples(self):
+        with self._lock:
+            items = dict(self._values)
+            fns = list(self._fns.items())
+        for key, fn in fns:
+            try:
+                items[key] = float(fn())
+            except Exception:  # noqa: BLE001 — a dead callback must not kill the scrape
+                items.pop(key, None)
+        return [(dict(zip(self.label_names, k)), v)
+                for k, v in items.items()]
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram with cumulative exposition and quantile
+    estimation (linear interpolation inside the landing bucket)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help, label_names, lock,
+                 buckets: tuple[float, ...] = LATENCY_BUCKETS_S):
+        super().__init__(name, help, label_names, lock)
+        b = tuple(sorted(float(x) for x in buckets))
+        if not b or any(b[i] >= b[i + 1] for i in range(len(b) - 1)):
+            raise ValueError("buckets must be non-empty and increasing")
+        self.buckets = b
+        # per labelset: ([count per finite bucket] + [overflow], sum, count)
+        self._series: dict[_LabelKey, tuple[list[int], float, int]] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(self.label_names, labels)
+        v = float(value)
+        with self._lock:
+            counts, total, n = self._series.get(
+                key, ([0] * (len(self.buckets) + 1), 0.0, 0))
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._series[key] = (counts, total + v, n + 1)
+
+    def snapshot(self, **labels) -> tuple[list[int], float, int]:
+        """(per-bucket counts + overflow, sum, count) for one labelset."""
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            counts, total, n = self._series.get(
+                key, ([0] * (len(self.buckets) + 1), 0.0, 0))
+            return list(counts), total, n
+
+    def percentile(self, q: float, **labels) -> float | None:
+        """Estimated q-quantile (q in [0,1]) from the bucket counts; None
+        with no observations. Overflow observations clamp to the top
+        bucket bound (the honest answer a fixed ladder can give)."""
+        counts, _total, _n = self.snapshot(**labels)
+        return percentile_from_counts(self.buckets, counts, q)
+
+    def samples(self):  # exposition is histogram-shaped; see expo.render
+        raise TypeError("histograms expose via expo.render, not samples()")
+
+
+def percentile_from_counts(buckets: tuple[float, ...], counts: list[int],
+                           q: float) -> float | None:
+    """q-quantile from per-bucket counts (finite buckets + overflow slot).
+
+    Module-level so callers holding a count DELTA (bench.py subtracts a
+    pre-measurement snapshot to keep warmup compiles out of the reported
+    percentiles) share the exact estimator the live histogram uses."""
+    n = sum(counts)
+    if n == 0:
+        return None
+    rank = q * n
+    seen = 0
+    for i, c in enumerate(counts[:-1]):
+        if seen + c >= rank and c > 0:
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i]
+            frac = (rank - seen) / c
+            return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        seen += c
+    return buckets[-1]
+
+
+class Registry:
+    """A named set of metrics plus scrape-time collectors."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+        self._collectors: list[Callable[[], Iterable]] = []
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       label_names: Iterable[str], **kw) -> _Metric:
+        label_names = tuple(label_names)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or m.label_names != label_names:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind}{m.label_names}"
+                    )
+                return m
+            m = cls(name, help, label_names, self._lock, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: Iterable[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Iterable[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Iterable[str] = (),
+                  buckets: tuple[float, ...] = LATENCY_BUCKETS_S) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labels,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> _Metric | None:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self) -> list[_Metric]:
+        with self._lock:
+            return sorted(self._metrics.values(), key=lambda m: m.name)
+
+    def register_collector(self, fn: Callable[[], Iterable]) -> None:
+        """``fn() -> iterable of (name, kind, help, [(labels, value), ...])``
+        evaluated at every scrape — for families whose source of truth
+        lives elsewhere (fault fire counts, cgroup stats)."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def collectors(self) -> list[Callable[[], Iterable]]:
+        with self._lock:
+            return list(self._collectors)
+
+
+_default = Registry()
+
+
+def get_default() -> Registry:
+    """The process-global registry (runner/daemon side: one process, one
+    scrape). Serving engines take a per-instance registry instead so tests
+    and multi-engine processes never cross-pollute."""
+    return _default

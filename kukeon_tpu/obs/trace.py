@@ -1,0 +1,123 @@
+"""Per-request trace spans: the full lifecycle of every generation request
+in a bounded ring buffer.
+
+A request's life is a chain of monotonic timestamps::
+
+    submitted -> admitted -> prefill_dispatched -> first_token -> finished
+
+and the exported span derives phase durations from CONSECUTIVE event
+pairs, so the phases partition the request's wall time exactly:
+``queued + prefill + decode == e2e`` (the acceptance tolerance exists only
+for float rounding). Requests that die early (shed at submit, deadline
+expiry while queued, cancel) simply stop the chain where they stopped —
+their later phases read 0 and the recorded outcome names why.
+
+The buffer is a ``deque(maxlen=capacity)``: O(1) append, oldest spans
+evicted, bounded memory no matter the traffic. ``GET /v1/trace?n=K``
+returns the newest K spans; log lines carry the same ``request_id`` so a
+span and its log records correlate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+
+# Event-chain order; phase N is the gap between event N and event N+1.
+EVENTS = ("submitted", "admitted", "prefill_dispatched", "first_token",
+          "finished")
+# Human phase names for the exported span, keyed by the gap's start event.
+_PHASE_OF = {
+    "submitted": "queued",            # submit -> dequeued for a slot
+    "admitted": "prefill_dispatch",   # dequeue -> prefill program dispatched
+    "prefill_dispatched": "prefill_wait",  # dispatch -> first token emitted
+    "first_token": "decode",          # first token -> terminal event
+}
+
+OUTCOMES = ("ok", "shed", "timeout", "cancelled", "error")
+
+
+@dataclasses.dataclass
+class Span:
+    """One request's lifecycle record (mutated only by the engine driver
+    thread until finish; read-only afterwards)."""
+
+    request_id: int
+    prompt_tokens: int
+    started_wall: float = dataclasses.field(default_factory=time.time)
+    events: list[tuple[str, float]] = dataclasses.field(default_factory=list)
+    outcome: str | None = None
+    error: str | None = None
+    tokens: int = 0
+    decode_chunks: int = 0
+
+    def __post_init__(self):
+        self.events.append(("submitted", time.monotonic()))
+
+    def event(self, name: str) -> None:
+        self.events.append((name, time.monotonic()))
+
+    @property
+    def finished(self) -> bool:
+        return self.outcome is not None
+
+    def to_dict(self) -> dict:
+        first = self.events[0][1]
+        last = self.events[-1][1]
+        phases: dict[str, float] = {}
+        for (name, t0), (_n, t1) in zip(self.events, self.events[1:]):
+            phase = _PHASE_OF.get(name, name)
+            phases[phase] = phases.get(phase, 0.0) + (t1 - t0)
+        return {
+            "requestId": self.request_id,
+            "startedAt": self.started_wall,
+            "outcome": self.outcome,
+            **({"error": self.error} if self.error else {}),
+            "promptTokens": self.prompt_tokens,
+            "tokens": self.tokens,
+            "decodeChunks": self.decode_chunks,
+            "events": [{"event": n, "atS": round(t - first, 6)}
+                       for n, t in self.events],
+            "phasesS": {k: round(v, 6) for k, v in phases.items()},
+            "e2eS": round(last - first, 6),
+        }
+
+
+class Tracer:
+    """Span factory + bounded completed-span buffer (thread-safe)."""
+
+    def __init__(self, capacity: int = 512):
+        self._lock = threading.Lock()
+        self._done: deque[Span] = deque(maxlen=max(1, capacity))
+
+    def begin(self, request_id: int, prompt_tokens: int) -> Span:
+        return Span(request_id=request_id, prompt_tokens=prompt_tokens)
+
+    def finish(self, span: Span, outcome: str, *, tokens: int | None = None,
+               error: str | None = None) -> Span:
+        """Terminal transition: stamps the ``finished`` event, records the
+        outcome, and moves the span into the ring. Idempotent — a request
+        failed twice (sweep + fail_all racing) keeps its FIRST verdict."""
+        if span.finished:
+            return span
+        span.event("finished")
+        span.outcome = outcome
+        if tokens is not None:
+            span.tokens = tokens
+        if error is not None:
+            span.error = error
+        with self._lock:
+            self._done.append(span)
+        return span
+
+    def recent(self, n: int = 50) -> list[dict]:
+        """Newest-first completed spans, at most ``n``."""
+        with self._lock:
+            spans = list(self._done)
+        return [s.to_dict() for s in reversed(spans[-max(0, n):])]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._done)

@@ -1,6 +1,7 @@
 """kuke: the CLI (reference: cmd/kuke, 23 verbs).
 
-Verbs: init, daemon (serve/start/stop/kill/restart/status/logs), apply,
+Verbs: init, daemon (serve/start/stop/kill/restart/status/logs/metrics),
+apply,
 create, delete, get, run, start, stop, kill, attach, log, purge, refresh,
 status, doctor, image, build, team, uninstall, version, autocomplete.
 
@@ -315,6 +316,17 @@ def cmd_daemon(args):
         except KukeonError as e:
             print(f"daemon unreachable: {e}", file=sys.stderr)
             return 1
+    if args.daemon_cmd == "metrics":
+        # Prometheus text straight from the daemon's registry: cell
+        # lifecycle (starts/restarts/exit codes/backoff/uptime), reconcile
+        # loop, RPC traffic, fault-injection fire counts.
+        try:
+            out = UnixClient(sock).call("Metrics")
+        except KukeonError as e:
+            print(f"daemon unreachable: {e}", file=sys.stderr)
+            return 1
+        print(out["text"], end="")
+        return 0
     if args.daemon_cmd == "logs":
         log_path = os.path.join(run_path, "kukeond.log")
         return _tail(log_path, follow=args.follow)
@@ -823,7 +835,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub_add("daemon")
     sp.add_argument("daemon_cmd", choices=["serve", "start", "stop", "kill",
-                                           "restart", "status", "logs"])
+                                           "restart", "status", "logs",
+                                           "metrics"])
     sp.add_argument("-f", "--follow", action="store_true")
 
     sp = sub_add("apply")

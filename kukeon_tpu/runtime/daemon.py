@@ -83,6 +83,16 @@ class RPCService:
         self.ctl = ctl
         self.server = server
         self.started_at = time.time()
+        # Daemon-side metrics land on the runner's registry so one scrape
+        # (Metrics RPC / `kuke daemon metrics`) covers RPC traffic, the
+        # reconcile loop, and cell lifecycle together.
+        reg = ctl.runner.registry
+        reg.gauge("kukeon_daemon_uptime_seconds",
+                  "Seconds since the RPC service came up.").set_function(
+            lambda: time.time() - self.started_at)
+        self._m_rpc = reg.counter(
+            "kukeon_daemon_rpc_requests_total",
+            "RPC calls by method and result.", labels=("method", "result"))
 
     # Every public method is an RPC endpoint.
 
@@ -318,6 +328,16 @@ class RPCService:
     def ReconcileNow(self) -> dict:
         return self.ctl.reconcile_cells()
 
+    def Metrics(self) -> dict:
+        """Prometheus text exposition of the daemon process: RPC traffic,
+        reconcile-loop activity, and the runner's cell-lifecycle metrics
+        (starts/restarts/exit codes/backoff/uptime). The CLI surfaces it
+        as `kuke daemon metrics`."""
+        from kukeon_tpu.obs import expo
+
+        return {"contentType": expo.CONTENT_TYPE,
+                "text": expo.render(self.ctl.runner.registry)}
+
     def Status(self) -> dict:
         ms = self.ctl.store.ms
         st = os.statvfs(ms.root)
@@ -345,6 +365,7 @@ class _Handler(socketserver.StreamRequestHandler):
             if not line:
                 continue
             req: dict | None = None
+            method = ""
             try:
                 req = json.loads(line)
                 rid = req.get("id")
@@ -354,13 +375,22 @@ class _Handler(socketserver.StreamRequestHandler):
                     raise InvalidArgument(f"unknown method {method!r}")
                 result = getattr(service, method)(**params)
                 resp = {"id": rid, "result": result}
+                service._m_rpc.inc(method=method, result="ok")
             except KukeonError as e:
                 resp = {"id": req.get("id") if isinstance(req, dict) else None,
                         "error": {"code": e.code, "message": str(e)}}
+                # Unknown method names must not mint label values (a bad
+                # client could otherwise grow the family without bound).
+                known = bool(method) and hasattr(service, method)
+                service._m_rpc.inc(method=method if known else "?",
+                                   result=e.code)
             except Exception as e:  # noqa: BLE001 — daemon must not die on a bad request
                 traceback.print_exc()
                 resp = {"id": req.get("id") if isinstance(req, dict) else None,
                         "error": {"code": "internal", "message": f"{type(e).__name__}: {e}"}}
+                known = bool(method) and hasattr(service, method)
+                service._m_rpc.inc(method=method if known else "?",
+                                   result="internal")
             try:
                 self.wfile.write((json.dumps(resp) + "\n").encode())
                 self.wfile.flush()
@@ -486,10 +516,24 @@ class DaemonServer:
             threading.Thread(target=self._server.shutdown, daemon=True).start()
 
     def _reconcile_loop(self) -> None:
+        reg = self.ctl.runner.registry
+        m_ticks = reg.counter("kukeon_daemon_reconcile_ticks_total",
+                              "Reconcile passes run by the ticker.")
+        m_outcomes = reg.counter(
+            "kukeon_daemon_reconcile_outcomes_total",
+            "Per-cell reconcile outcomes accumulated over all ticks.",
+            labels=("outcome",))
+        m_dur = reg.histogram("kukeon_daemon_reconcile_seconds",
+                              "Wall time of one full reconcile pass.")
         while not self._shutdown.wait(self.reconcile_interval_s):
             try:
-                self.ctl.reconcile_cells()
+                t0 = time.monotonic()
+                counts = self.ctl.reconcile_cells()
                 self.ctl.reconcile_space_networks()
+                m_dur.observe(time.monotonic() - t0)
+                m_ticks.inc()
+                for outcome, n in counts.items():
+                    m_outcomes.inc(n, outcome=outcome)
             except Exception:  # noqa: BLE001 — ticker must survive
                 traceback.print_exc()
 

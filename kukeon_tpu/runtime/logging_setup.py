@@ -1,17 +1,31 @@
-"""Logging subsystem: one timestamped, quoted-message text format.
+"""Logging subsystem: one timestamped, quoted-message text format, plus an
+opt-in structured JSON mode.
 
 Reference: internal/logging/handler.go:28-40 — the slog ReformatHandler
 every kukeon binary installs (`time level "message" key=value ...`), plus a
 noop logger for tests. Here: a logging.Formatter with the same line shape,
 a single ``setup()`` every entrypoint calls (daemon, CLI verbs, serving
 cell), and level resolution from KUKEOND_LOG_LEVEL / ServerConfiguration.
+
+``KUKEON_LOG_FORMAT=json`` (or ``setup(fmt="json")``) switches every line
+to one JSON object: ``{"ts", "level", "msg", "logger"}`` plus whatever
+correlation fields the call site attached via ``extra=`` — the serving
+engine stamps ``request_id`` and ``phase`` on request-lifecycle records,
+and the ambient cell name (KUKEON_CELL, injected by the runner) rides
+along as ``cell`` — so a log pipeline joins log lines to /v1/trace spans
+by request id. Plain text remains the default.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import sys
 import time
+
+# Correlation fields lifted from record ``extra=`` into the JSON object.
+_EXTRA_FIELDS = ("request_id", "cell", "phase", "point", "outcome")
 
 _LEVELS = {
     "debug": logging.DEBUG,
@@ -39,11 +53,50 @@ class ReformatFormatter(logging.Formatter):
         return line
 
 
-def setup(level: str | int | None = None, stream=None) -> None:
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line with correlation fields.
+
+    ``cell`` defaults from KUKEON_CELL (the runner injects it into every
+    container env) so multi-cell log aggregation needs no per-call-site
+    plumbing; an explicit ``extra={"cell": ...}`` wins."""
+
+    converter = time.gmtime
+
+    def __init__(self):
+        super().__init__()
+        self._cell = os.environ.get("KUKEON_CELL")
+
+    def format(self, record: logging.LogRecord) -> str:
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S", self.converter(record.created))
+        obj = {
+            "ts": f"{ts}.{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "msg": record.getMessage(),
+            "logger": record.name,
+        }
+        if self._cell is not None:
+            obj["cell"] = self._cell
+        for key in _EXTRA_FIELDS:
+            v = record.__dict__.get(key)
+            if v is not None:
+                obj[key] = v
+        if record.exc_info:
+            obj["exc"] = self.formatException(record.exc_info)
+        return json.dumps(obj)
+
+
+def _resolve_formatter(fmt: str | None) -> logging.Formatter:
+    fmt = (fmt or os.environ.get("KUKEON_LOG_FORMAT") or "text").lower()
+    return JsonFormatter() if fmt == "json" else ReformatFormatter()
+
+
+def setup(level: str | int | None = None, stream=None,
+          fmt: str | None = None) -> None:
     """Install the kukeon handler on the root `kukeon` logger (idempotent).
 
     ``level``: name or numeric; defaults to INFO. Child loggers
-    (kukeon.runner, kukeon.net, ...) inherit.
+    (kukeon.runner, kukeon.net, ...) inherit. ``fmt``: "text" (default) or
+    "json"; unset falls back to KUKEON_LOG_FORMAT.
     """
     if isinstance(level, str):
         level = _LEVELS.get(level.lower(), logging.INFO)
@@ -53,9 +106,12 @@ def setup(level: str | int | None = None, stream=None) -> None:
     for h in root.handlers:
         if getattr(h, "_kukeon", False):
             h.setStream(stream) if hasattr(h, "setStream") else None
+            # Re-setup may switch formats (a test flips KUKEON_LOG_FORMAT;
+            # the daemon re-reads its configuration).
+            h.setFormatter(_resolve_formatter(fmt))
             return
     handler = logging.StreamHandler(stream)
-    handler.setFormatter(ReformatFormatter())
+    handler.setFormatter(_resolve_formatter(fmt))
     handler._kukeon = True  # type: ignore[attr-defined]
     root.addHandler(handler)
     root.propagate = False

@@ -23,6 +23,7 @@ import sys
 import threading
 import time
 
+from kukeon_tpu import obs
 from kukeon_tpu.runtime import consts, model
 from kukeon_tpu.runtime.api import types as t
 from kukeon_tpu.runtime.cells.backend import CellBackend, ContainerContext
@@ -59,6 +60,7 @@ class Runner:
         devices: TPUDeviceManager | None = None,
         options: RunnerOptions | None = None,
         netman=None,
+        registry: "obs.Registry | None" = None,
     ):
         self.store = store
         self.backend = backend
@@ -70,6 +72,37 @@ class Runner:
         self._locks_guard = threading.Lock()
         # (owner, container, repo idx) -> last failed clone attempt time.
         self._repo_failures: dict[tuple, float] = {}
+        # Cell-lifecycle metrics (daemon Metrics RPC / `kuke daemon
+        # metrics` scrape them). Default registry is process-global: one
+        # daemon process, one scrape; tests inject a fresh Registry.
+        self.registry = registry or obs.get_default()
+        reg = self.registry
+        self._m_cell_starts = reg.counter(
+            "kukeon_runner_cell_starts_total",
+            "Cell start operations (initial starts; restarts count "
+            "separately).", labels=("cell",))
+        self._m_restarts = reg.counter(
+            "kukeon_runner_container_restarts_total",
+            "Restart-policy container restarts.",
+            labels=("cell", "container"))
+        self._m_exits = reg.counter(
+            "kukeon_runner_container_exits_total",
+            "Observed container exits by exit code.",
+            labels=("cell", "container", "code"))
+        self._m_uptime = reg.gauge(
+            "kukeon_runner_container_uptime_seconds",
+            "Continuous uptime of a running container (refreshed every "
+            "reconcile tick; 0 when not running).",
+            labels=("cell", "container"))
+        self._m_backoff = reg.gauge(
+            "kukeon_runner_restart_backoff_seconds",
+            "Remaining restart backoff for an exited container "
+            "(0 = no restart pending).", labels=("cell", "container"))
+        self._m_exhausted = reg.gauge(
+            "kukeon_runner_restart_budget_exhausted",
+            "1 when a container crash-looped past restartMaxRetries.",
+            labels=("cell", "container"))
+        reg.register_collector(obs.faults_collector)
 
     # --- locking (reference: runner/cell_lock.go) --------------------------
 
@@ -301,6 +334,7 @@ class Runner:
         rec.desired_state = "running"
         self._derive_phase(rec)
         self.store.write_cell(rec)
+        self._m_cell_starts.inc(cell=self._owner_key(rec))
         return rec
 
     @staticmethod
@@ -737,6 +771,7 @@ class Runner:
         outcome = OUTCOME_STEADY
         containers = self.cell_containers(rec)
         changed = False
+        owner = self._owner_key(rec)
 
         for spec in containers:
             st = rec.status.container(spec.name)
@@ -753,6 +788,11 @@ class Runner:
                 st.exit_code = live.exit_code
                 if live.exited and st.finished_at is None:
                     st.finished_at = time.time()
+                    # Newly observed exit: count it by code so a crash
+                    # loop's signature (e.g. the watchdog's 86) is visible
+                    # on the daemon scrape, not only in `kuke get`.
+                    self._m_exits.inc(cell=owner, container=spec.name,
+                                      code=str(live.exit_code or 0))
                 if live.exited and (live.exit_code or 0) != 0:
                     # Capture WHY before the restart path wipes the run
                     # artifacts: the log tail at a non-clean exit is the
@@ -762,6 +802,24 @@ class Runner:
                     if tail:
                         st.last_error = tail
                         changed = True
+
+            # Lifecycle gauges, refreshed every reconcile tick: uptime for
+            # running containers, remaining restart backoff for exited
+            # ones waiting on their window, budget-exhaustion as a flag.
+            anchor = st.last_restart_at or st.started_at
+            self._m_uptime.set(
+                (time.time() - anchor) if (live.running and anchor) else 0.0,
+                cell=owner, container=spec.name)
+            self._m_backoff.set(
+                self._backoff_remaining(spec, st) if live.exited else 0.0,
+                cell=owner, container=spec.name)
+            self._m_exhausted.set(
+                1.0 if (live.exited
+                        and spec.restart_policy.policy != "never"
+                        and spec.restart_policy.max_retries is not None
+                        and st.restarts >= spec.restart_policy.max_retries)
+                else 0.0,
+                cell=owner, container=spec.name)
 
             if live.running:
                 # Restart-budget replenishment: a container that has stayed
@@ -806,6 +864,8 @@ class Runner:
                 st.restarts += 1
                 st.last_restart_at = time.time()
                 st.finished_at = None
+                self._m_restarts.inc(cell=owner, container=spec.name)
+                self._m_backoff.set(0.0, cell=owner, container=spec.name)
                 if (prev_exit or 0) != 0:
                     why = f": {st.last_error}" if st.last_error else ""
                     rec.status.reason = (
@@ -896,6 +956,22 @@ class Runner:
                 if m:
                     out[spec.name] = m
         return out
+
+    def _backoff_remaining(self, spec: t.ContainerSpec,
+                           st: model.ContainerStatus) -> float:
+        """Seconds until an exited container's restart window opens; 0 when
+        no restart is pending (policy says no, budget spent, or due now)."""
+        rp = spec.restart_policy
+        if rp.policy == "never":
+            return 0.0
+        if rp.policy == "on-failure" and (st.exit_code == 0):
+            return 0.0
+        if rp.max_retries is not None and st.restarts >= rp.max_retries:
+            return 0.0
+        anchor = st.last_restart_at or st.finished_at
+        if anchor is None:
+            return 0.0
+        return max(0.0, rp.backoff_seconds - (time.time() - anchor))
 
     def _restart_due(self, spec: t.ContainerSpec, st: model.ContainerStatus) -> bool:
         rp = spec.restart_policy

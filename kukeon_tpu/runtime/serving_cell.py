@@ -10,7 +10,14 @@ the mesh over whatever devices JAX exposes and serves:
   GET  /readyz       -> readiness (503 until warmup completes, while
                         draining, and after the TPU watchdog trips)
   POST /drain        -> stop admitting, finish in-flight, then exit cleanly
-  GET  /v1/stats     -> slots/queue/throughput counters
+  GET  /v1/stats     -> slots/queue/throughput counters (a JSON view over
+                        the same obs registry /metrics scrapes)
+  GET  /metrics      -> Prometheus text exposition: engine latency
+                        histograms (TTFT/inter-token/e2e/queue-wait/
+                        prefill-by-bucket), shed/timeout/watchdog/fault
+                        counters, slot/queue gauges (kukeon_tpu/obs)
+  GET  /v1/trace?n=K -> newest K per-request trace spans (lifecycle events
+                        + per-phase durations summing to e2e)
   POST /v1/generate  -> {"promptTokens": [...] | "prompt": "text",
                          "maxNewTokens": N, "temperature": T,
                          "deadlineS": D, ...}
@@ -39,6 +46,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from kukeon_tpu import faults
+from kukeon_tpu.obs import Registry, expo
 from kukeon_tpu.serving.engine import DeadlineExceeded, RejectedError
 
 MODELS = {}
@@ -74,6 +82,40 @@ class LifecycleMixin:
         # main() points this at server.shutdown so a finished drain unblocks
         # serve_forever and the process exits 0.
         self.on_drained = None
+
+    def _init_cell_obs(self, registry: Registry, kind: str) -> None:
+        """Cell-level observability shared by both cell flavors: lifecycle
+        gauges (scrape-time callables — zero cost between scrapes) plus
+        the fault-injection fire-count family, all on the one registry
+        ``GET /metrics`` renders."""
+        self.registry = registry
+        registry.gauge("kukeon_cell_info",
+                       "Static cell identity (value always 1).",
+                       labels=("model", "kind")).set(
+            1, model=self.model_name, kind=kind)
+        registry.gauge("kukeon_cell_uptime_seconds",
+                       "Seconds since cell construction.").set_function(
+            lambda: time.time() - self.started_at)
+        registry.gauge("kukeon_cell_ready",
+                       "1 while admitting requests (readyz).").set_function(
+            lambda: 1.0 if self.readiness()[0] else 0.0)
+        registry.gauge("kukeon_cell_draining",
+                       "1 while a drain is in progress.").set_function(
+            lambda: 1.0 if self.draining else 0.0)
+        registry.gauge("kukeon_cell_http_inflight",
+                       "HTTP requests currently being served.").set_function(
+            lambda: float(self._inflight))
+        # Pre-declare the watchdog families so a scrape sees them at zero
+        # even before (or without) an EngineWatchdog — the watchdog's own
+        # get-or-create then lands on these same counters.
+        registry.counter(
+            "kukeon_watchdog_probes_total",
+            "TPU runtime probes fired after an engine stall.",
+            labels=("verdict",))
+        registry.counter(
+            "kukeon_watchdog_trips_total",
+            "Wedged verdicts (the cell exits for restart right after).")
+        registry.register_collector(expo.faults_collector)
 
     def mark_ready(self):
         self.unready_reason = None
@@ -316,13 +358,16 @@ class ServingCell(LifecycleMixin):
         # (bench.py --autotune): levers the operator left unset
         # (decode_chunk/kv_cache_int8 None) boot at the swept winner for
         # this model+backend+chip-count.
+        # One registry for the whole cell: engine metrics and cell
+        # lifecycle gauges land in the same /metrics exposition.
+        registry = Registry()
         self.engine = ServingEngine(
             cfg, params, mesh, num_slots=num_slots,
             max_seq_len=max_seq_len or min(cfg.max_seq_len, 4096),
             kv_cache_int8=kv_cache_int8, async_load=True,
             forward_fn=forward_fn, param_specs=param_specs,
             decode_chunk=decode_chunk, model_name=model,
-            max_pending=max_pending,
+            max_pending=max_pending, registry=registry,
         )
         from kukeon_tpu.serving.tokenizer import load_tokenizer
 
@@ -333,6 +378,7 @@ class ServingCell(LifecycleMixin):
         # Default per-request deadline; a request's own deadlineS wins.
         self.default_deadline_s = deadline_s
         self._init_lifecycle()
+        self._init_cell_obs(registry, kind="decoder")
 
     @staticmethod
     def _load_checkpoint(path: str, cfg, quantize: bool = False):
@@ -516,16 +562,24 @@ class ServingCell(LifecycleMixin):
         self.engine.stop()
 
     def stats(self) -> dict:
+        """JSON stats view over the obs registry: every counter/gauge here
+        reads the same instruments /metrics renders (shed_stats is a
+        registry-counter view, the gauges are the registry's scrape-time
+        callables) — one source of truth, two presentations."""
         import jax
 
+        reg = self.registry
         ready, unready_why = self.readiness()
         return {
             "model": self.model_name,
             "devices": [str(d) for d in jax.devices()],
-            "numSlots": self.engine.num_slots,
-            "freeSlots": len(self.engine._free_slots()),
-            "uptimeSeconds": round(time.time() - self.started_at, 1),
+            "numSlots": int(reg.get("kukeon_engine_slots_total").value()),
+            "freeSlots": int(reg.get("kukeon_engine_slots_free").value()),
+            "uptimeSeconds": round(
+                reg.get("kukeon_cell_uptime_seconds").value(), 1),
             "totalTokens": self.total_tokens,
+            "generatedTokens": int(
+                reg.get("kukeon_engine_tokens_total").value()),
             "prefixCache": {"hits": self.engine.prefix_hits,
                             "misses": self.engine.prefix_misses,
                             "entries": len(self.engine._prefix_cache)},
@@ -537,7 +591,7 @@ class ServingCell(LifecycleMixin):
             # Overload/lifecycle counters (the shed accounting the stress
             # tier asserts on): queueDepth is live, rejected/timedOut are
             # monotonic totals since boot.
-            "queueDepth": self.engine.queue_depth,
+            "queueDepth": int(reg.get("kukeon_engine_queue_depth").value()),
             "maxPending": self.engine.max_pending,
             "rejected": self.engine.shed_stats["rejected"],
             "timedOut": self.engine.shed_stats["timed_out"],
@@ -593,6 +647,16 @@ class EmbeddingCell(LifecycleMixin):
         self.total_sequences = 0
         self._stats_lock = threading.Lock()
         self._init_lifecycle()
+        self._init_cell_obs(Registry(), kind="embedding")
+        self.registry.gauge(
+            "kukeon_embed_batch_size",
+            "Embedding micro-batch grid size.").set(batch_size)
+        self.registry.register_collector(self._obs_collect)
+
+    def _obs_collect(self):
+        yield ("kukeon_embed_sequences_total", "counter",
+               "Sequences embedded since boot.",
+               [({}, float(self.total_sequences))])
 
     @staticmethod
     def _load_checkpoint(path: str, cfg):
@@ -635,13 +699,20 @@ class EmbeddingCell(LifecycleMixin):
     def stats(self) -> dict:
         import jax
 
+        # ready/draining/uptime parity with the decoder cell's stats: a
+        # scraper (or the reconciler) treats both cell flavors uniformly.
+        ready, unready_why = self.readiness()
         return {
             "model": self.model_name,
             "kind": "embedding",
             "devices": [str(d) for d in jax.devices()],
             "batchSize": self.engine.batch_size,
-            "uptimeSeconds": round(time.time() - self.started_at, 1),
+            "uptimeSeconds": round(
+                self.registry.get("kukeon_cell_uptime_seconds").value(), 1),
             "totalSequences": self.total_sequences,
+            "ready": ready,
+            "draining": self.draining,
+            **({"unreadyReason": unready_why} if unready_why else {}),
         }
 
 
@@ -665,7 +736,8 @@ class EngineWatchdog(threading.Thread):
 
     def __init__(self, engine, *, stall_budget_s: float,
                  probe=None, on_wedged=None, interval_s: float | None = None,
-                 probe_timeout_s: float = 20.0):
+                 probe_timeout_s: float = 20.0,
+                 registry: Registry | None = None):
         super().__init__(daemon=True, name="tpu-watchdog")
         self.engine = engine
         self.stall_budget_s = stall_budget_s
@@ -678,6 +750,16 @@ class EngineWatchdog(threading.Thread):
         self.last_verdict: tuple[str, str] | None = None
         self.probes = 0
         self._halt = threading.Event()
+        # Watchdog activity on the cell's scrape: every probe is a sign
+        # the engine stalled past budget; a trip precedes the exit-86.
+        reg = registry if registry is not None else Registry()
+        self._m_probes = reg.counter(
+            "kukeon_watchdog_probes_total",
+            "TPU runtime probes fired after an engine stall.",
+            labels=("verdict",))
+        self._m_trips = reg.counter(
+            "kukeon_watchdog_trips_total",
+            "Wedged verdicts (the cell exits for restart right after).")
 
     def stop(self):
         self._halt.set()
@@ -693,8 +775,10 @@ class EngineWatchdog(threading.Thread):
             self.probes += 1
             status, detail = probe(timeout_s=self.probe_timeout_s)
             self.last_verdict = (status, detail)
+            self._m_probes.inc(verdict=status)
             if status == "wedged":
                 self.tripped = True
+                self._m_trips.inc()
                 if self.on_wedged is not None:
                     self.on_wedged(detail)
                 return
@@ -720,6 +804,14 @@ def make_handler(cell: ServingCell):
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_text(self, code: int, text: str, content_type: str):
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def _reject(self, e: RejectedError):
             """429 (engine queue full — retry against THIS cell) or 503
             (lifecycle: warming up/draining/wedged — retry elsewhere), both
@@ -735,18 +827,40 @@ def make_handler(cell: ServingCell):
                                 str(max(1, math.ceil(e.retry_after_s)))})
 
         def do_GET(self):
-            if self.path == "/v1/health" or self.path == "/healthz":
+            from urllib.parse import parse_qs, urlsplit
+
+            parts = urlsplit(self.path)
+            path = parts.path
+            if path == "/v1/health" or path == "/healthz":
                 # Liveness: answering at all is the signal.
                 self._send(200, {"status": "ok", "model": cell.model_name})
-            elif self.path == "/readyz":
+            elif path == "/readyz":
                 ok, why = (cell.readiness() if hasattr(cell, "readiness")
                            else (True, None))
                 if ok:
                     self._send(200, {"ready": True})
                 else:
                     self._send(503, {"ready": False, "reason": why})
-            elif self.path == "/v1/stats":
+            elif path == "/v1/stats":
                 self._send(200, cell.stats())
+            elif path == "/metrics":
+                # Prometheus text exposition over the cell's registry
+                # (engine histograms + lifecycle gauges + fault counters).
+                self._send_text(200, expo.render(cell.registry),
+                                expo.CONTENT_TYPE)
+            elif path == "/v1/trace":
+                tracer = getattr(getattr(cell, "engine", None),
+                                 "tracer", None)
+                if tracer is None:
+                    self._send(404, {"error": "this cell records no "
+                                              "request traces"})
+                    return
+                try:
+                    n = int(parse_qs(parts.query).get("n", ["50"])[0])
+                except ValueError:
+                    self._send(400, {"error": "n must be an integer"})
+                    return
+                self._send(200, {"spans": tracer.recent(n)})
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
@@ -925,6 +1039,7 @@ def main(argv=None) -> int:
             cell.engine, stall_budget_s=budget, on_wedged=_wedged,
             probe_timeout_s=float(
                 os.environ.get(WATCHDOG_PROBE_TIMEOUT_ENV, "20") or 20),
+            registry=cell.registry,
         )
         watchdog.start()
 

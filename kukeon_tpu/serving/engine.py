@@ -36,9 +36,11 @@ copying sampled token blocks out. All math is inside three jitted programs.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
 import threading
 import time
+from collections.abc import Mapping
 from typing import Any, Callable
 
 import jax
@@ -48,6 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from kukeon_tpu import faults
 from kukeon_tpu.models import llama
+from kukeon_tpu.obs import Registry, Tracer, faults_collector
 from kukeon_tpu.parallel import sharding as shd
 from kukeon_tpu.parallel.mesh import set_mesh
 from kukeon_tpu.serving.sampling import (
@@ -57,6 +60,33 @@ from kukeon_tpu.serving.sampling import (
 )
 
 PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096)
+
+_LOG = logging.getLogger("kukeon.serving.engine")
+
+
+class _CounterMapView(Mapping):
+    """Read-only dict view over a labelled registry counter.
+
+    PR 2's ``shed_stats`` dict migrated onto the metrics registry; this
+    keeps every existing reader (``/v1/stats``, tests, operators poking the
+    engine in a REPL) working unchanged while the registry is the single
+    source of truth the Prometheus exposition scrapes."""
+
+    def __init__(self, counter, label: str, keys: tuple[str, ...]):
+        self._counter = counter
+        self._label = label
+        self._keys = keys
+
+    def __getitem__(self, key: str) -> int:
+        if key not in self._keys:
+            raise KeyError(key)
+        return int(self._counter.value(**{self._label: key}))
+
+    def __iter__(self):
+        return iter(self._keys)
+
+    def __len__(self) -> int:
+        return len(self._keys)
 
 
 class RejectedError(RuntimeError):
@@ -99,6 +129,10 @@ class Request:
     slot: int = -1
     submitted_at: float = 0.0
     first_token_at: float = 0.0
+    last_token_at: float = 0.0
+    # Observability: the request's trace span (obs/trace.py). The engine
+    # driver stamps lifecycle events on it; /v1/trace exports it.
+    trace: Any = None
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     cancelled: bool = False
     # Absolute monotonic deadline (None = no deadline). Checked at dequeue
@@ -183,6 +217,8 @@ class ServingEngine:
         prefill_buckets: tuple[int, ...] | None = None,
         model_name: str | None = None,
         max_pending: int | None = None,
+        registry: Registry | None = None,
+        trace_capacity: int = 512,
     ):
         # Model pluggability: any forward with llama.forward's signature
         # ((params, cfg, tokens, positions, cache) -> (logits, cache')) and
@@ -277,7 +313,10 @@ class ServingEngine:
         # host→device array upload through _upload, so tests can assert the
         # decode loop performs ≤1 blocking transfer per chunk instead of
         # guessing from timings. "chunks" counts dispatched decode chunks.
-        self.sync_stats = {"fetches": 0, "uploads": 0, "chunks": 0}
+        # *_s accumulate wall time spent blocked in each transfer kind
+        # (scraped as kukeon_engine_host_sync_seconds_total).
+        self.sync_stats = {"fetches": 0, "uploads": 0, "chunks": 0,
+                           "fetch_s": 0.0, "upload_s": 0.0}
 
         if mesh is None:
             raise ValueError("ServingEngine requires a mesh (use make_mesh(tensor=1) for one device)")
@@ -342,7 +381,61 @@ class ServingEngine:
         self.max_pending = max_pending
         self._pending_n = 0
         self.retry_after_s = 1.0
-        self.shed_stats = {"rejected": 0, "timed_out": 0}
+
+        # --- observability (obs/) -------------------------------------
+        # Per-engine registry by default: tests and multi-engine processes
+        # must never cross-pollute; the serving cell injects its own so
+        # cell-level and engine-level metrics share one /metrics scrape.
+        self.registry = registry or Registry()
+        self.tracer = Tracer(capacity=trace_capacity)
+        reg = self.registry
+        self._m_queue_wait = reg.histogram(
+            "kukeon_engine_queue_wait_seconds",
+            "Submit -> dequeued-for-a-slot wait.")
+        self._m_prefill = reg.histogram(
+            "kukeon_engine_prefill_seconds",
+            "Prefill dispatch latency by padded prompt bucket.",
+            labels=("bucket",))
+        self._m_ttft = reg.histogram(
+            "kukeon_engine_ttft_seconds",
+            "Submit -> first token emitted (time to first token).")
+        self._m_itl = reg.histogram(
+            "kukeon_engine_inter_token_seconds",
+            "Gap between consecutive emitted tokens of one request.")
+        self._m_e2e = reg.histogram(
+            "kukeon_engine_e2e_seconds",
+            "Submit -> terminal event (any outcome).")
+        self._m_tokens = reg.counter(
+            "kukeon_engine_tokens_total", "Tokens emitted.")
+        self._m_requests = reg.counter(
+            "kukeon_engine_requests_total",
+            "Requests reaching a terminal event, by outcome.",
+            labels=("outcome",))
+        self._m_shed = reg.counter(
+            "kukeon_engine_shed_total",
+            "Load-shedding events (rejected = queue full at submit, "
+            "timed_out = deadline expired).", labels=("reason",))
+        # The PR-2 shed dict is now a registry view (same keys, same reads).
+        self.shed_stats = _CounterMapView(
+            self._m_shed, "reason", ("rejected", "timed_out"))
+        reg.gauge("kukeon_engine_slots_total",
+                  "Decode slots in the fixed batch.").set(num_slots)
+        reg.gauge("kukeon_engine_slots_free",
+                  "Slots with no active request.").set_function(
+            lambda: len(self._free_slots()))
+        reg.gauge("kukeon_engine_queue_depth",
+                  "Admitted-not-yet-slotted requests.").set_function(
+            lambda: self._pending_n)
+        reg.gauge("kukeon_engine_max_pending",
+                  "Admission bound (-1 = unbounded).").set(
+            -1 if max_pending is None else max_pending)
+        # Transfer/prefix-cache counters surface at scrape time from the
+        # live dicts (zero extra work on the decode hot path — the roofline
+        # budget in test_decode_host_sync_budget stays untouched). The
+        # fault-point family rides along: most fault seams live in this
+        # module, so an engine scrape is complete without a cell wrapper.
+        reg.register_collector(self._obs_collect)
+        reg.register_collector(faults_collector)
         # Progress heartbeat for the TPU watchdog: bumped on submit and on
         # every step() that did work. A wedged runtime blocks the driver
         # inside a device call, so this goes stale while work is queued —
@@ -541,17 +634,66 @@ class ServingEngine:
         return bucket_length(n, self.prefill_buckets)
 
     def _fetch(self, x) -> np.ndarray:
-        """Blocking device→host readback, counted (the roofline budget is
-        ≤1 per decode chunk — tests/test_serving.py asserts it here)."""
+        """Blocking device→host readback, counted and timed (the roofline
+        budget is ≤1 per decode chunk — tests/test_serving.py asserts it
+        here)."""
         faults.maybe_fail("engine.fetch")
+        t0 = time.monotonic()
+        out = np.asarray(x)
         self.sync_stats["fetches"] += 1
-        return np.asarray(x)
+        self.sync_stats["fetch_s"] += time.monotonic() - t0
+        return out
 
     def _upload(self, x):
-        """Host→device array upload, counted."""
+        """Host→device array upload, counted and timed."""
         faults.maybe_fail("engine.upload")
+        t0 = time.monotonic()
+        out = jnp.asarray(x)
         self.sync_stats["uploads"] += 1
-        return jnp.asarray(x)
+        self.sync_stats["upload_s"] += time.monotonic() - t0
+        return out
+
+    def _obs_collect(self):
+        """Scrape-time counter families sourced from the live dicts the
+        hot path already maintains (sync_stats is bumped inside _fetch /
+        _upload with no lock; mirroring it here instead of double-counting
+        keeps the decode loop's instrumentation overhead at zero)."""
+        s = self.sync_stats
+        yield ("kukeon_engine_host_sync_total", "counter",
+               "Blocking host<->device transfers (fetch = device->host "
+               "readback, upload = host->device array).",
+               [({"kind": "fetch"}, float(s["fetches"])),
+                ({"kind": "upload"}, float(s["uploads"]))])
+        yield ("kukeon_engine_host_sync_seconds_total", "counter",
+               "Wall time spent blocked in host<->device transfers.",
+               [({"kind": "fetch"}, float(s["fetch_s"])),
+                ({"kind": "upload"}, float(s["upload_s"]))])
+        yield ("kukeon_engine_decode_chunks_total", "counter",
+               "Dispatched multi-step decode chunks.",
+               [({}, float(s["chunks"]))])
+        yield ("kukeon_engine_prefix_cache_total", "counter",
+               "Prefix-KV cache lookups by result.",
+               [({"result": "hit"}, float(self.prefix_hits)),
+                ({"result": "miss"}, float(self.prefix_misses))])
+
+    def _observe_terminal(self, req: Request, outcome: str) -> None:
+        """Record a request's terminal event on every instrument at once:
+        e2e histogram, outcome counter, trace span, correlated log line.
+        Exactly one terminal per request — callers run on the driver
+        thread (or hold the failure path), and Tracer.finish is idempotent
+        so a double-fault keeps the first verdict."""
+        if req.submitted_at:
+            self._m_e2e.observe(time.monotonic() - req.submitted_at)
+        self._m_requests.inc(outcome=outcome)
+        if req.trace is not None:
+            self.tracer.finish(
+                req.trace, outcome, tokens=len(req.generated),
+                error=(f"{type(req.error).__name__}: {req.error}"
+                       if req.error is not None else None),
+            )
+        _LOG.debug("request %d %s (%d tokens)", req.id, outcome,
+                   len(req.generated),
+                   extra={"request_id": req.id, "phase": outcome})
 
     def _ensure_loaded(self):
         """Block until the (possibly async) weight transfer finished."""
@@ -652,26 +794,37 @@ class ServingEngine:
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError("deadline_s must be positive")
         now = time.monotonic()
+        shed_depth = None
         with self._lock:
             if (self.max_pending is not None
                     and self._pending_n >= self.max_pending):
-                self.shed_stats["rejected"] += 1
-                raise RejectedError(
-                    f"pending queue full ({self._pending_n}/"
-                    f"{self.max_pending}); shedding load",
-                    retry_after_s=self.retry_after_s,
+                shed_depth = self._pending_n
+            else:
+                req = Request(
+                    id=self._next_id, prompt=prompt,
+                    sampling=sampling or SamplingParams(),
+                    emit=emit, submitted_at=now,
+                    prefix_id=prefix_id,
+                    deadline=(now + deadline_s)
+                    if deadline_s is not None else None,
                 )
-            req = Request(
-                id=self._next_id, prompt=prompt,
-                sampling=sampling or SamplingParams(),
-                emit=emit, submitted_at=now,
-                prefix_id=prefix_id,
-                deadline=(now + deadline_s) if deadline_s is not None else None,
+                self._next_id += 1
+                self._requests[req.id] = req
+                self._pending_n += 1
+                self.last_progress = now
+        if shed_depth is not None:
+            # Shed accounting outside the lock: counter + a zero-length
+            # trace span (id -1: the request never earned one) so the shed
+            # path is visible in /v1/trace, not just as a counter.
+            self._m_shed.inc(reason="rejected")
+            self._m_requests.inc(outcome="shed")
+            self.tracer.finish(self.tracer.begin(-1, prompt.size), "shed")
+            raise RejectedError(
+                f"pending queue full ({shed_depth}/"
+                f"{self.max_pending}); shedding load",
+                retry_after_s=self.retry_after_s,
             )
-            self._next_id += 1
-            self._requests[req.id] = req
-            self._pending_n += 1
-            self.last_progress = now
+        req.trace = self.tracer.begin(req.id, int(prompt.size))
         self._pending.put(req)
         return req
 
@@ -776,6 +929,7 @@ class ServingEngine:
         req.error = exc
         with self._lock:
             self._requests.pop(req.id, None)
+        self._observe_terminal(req, "error")
         if req.emit:
             try:
                 req.emit(-1, True)
@@ -825,7 +979,7 @@ class ServingEngine:
                 self._release_slot(req, cancelled=True)
                 did = True
             elif self._expired(req, now):
-                self.shed_stats["timed_out"] += 1
+                self._m_shed.inc(reason="timed_out")
                 req.timed_out = True
                 req.error = DeadlineExceeded(
                     f"request {req.id} deadline exceeded after "
@@ -859,6 +1013,7 @@ class ServingEngine:
         with self._lock:
             self._requests.pop(req.id, None)
             self._pending_n -= 1
+        self._observe_terminal(req, "cancelled")
         if req.emit:
             req.emit(-1, True)
         req.done.set()
@@ -869,12 +1024,13 @@ class ServingEngine:
         with self._lock:
             self._requests.pop(req.id, None)
             self._pending_n -= 1
-        self.shed_stats["timed_out"] += 1
+        self._m_shed.inc(reason="timed_out")
         req.timed_out = True
         req.error = DeadlineExceeded(
             f"request {req.id} deadline exceeded while queued "
             f"({time.monotonic() - req.submitted_at:.2f}s in queue)"
         )
+        self._observe_terminal(req, "timeout")
         if req.emit:
             req.emit(-1, True)
         req.done.set()
@@ -922,6 +1078,9 @@ class ServingEngine:
                 break
             with self._lock:
                 self._pending_n -= 1   # leaving the queue for a slot
+            self._m_queue_wait.observe(time.monotonic() - req.submitted_at)
+            if req.trace is not None:
+                req.trace.event("admitted")
             try:
                 prefills.append(self._dispatch_prefill(req, slot))
             except Exception as e:
@@ -998,6 +1157,7 @@ class ServingEngine:
         resulting prompt KV is (re)stored under the request's prefix_id
         either way."""
         faults.maybe_fail("engine.prefill")
+        t0 = time.monotonic()
         n = req.prompt.size
         sp = req.sampling
         cached = self._prefix_lookup(req)
@@ -1030,7 +1190,11 @@ class ServingEngine:
                 self._prefix_store(req.prefix_id, req.prompt, kv_k, kv_v)
             self.state = self._insert(self.state, kv_k, kv_v, n, slot, first)
         req.slot = slot
-        req.first_token_at = time.monotonic()
+        # Dispatch latency by padded bucket (host-side dispatch + any
+        # compile; the device-side wait lands in the TTFT histogram).
+        self._m_prefill.observe(time.monotonic() - t0, bucket=str(bucket))
+        if req.trace is not None:
+            req.trace.event("prefill_dispatched")
         self._slot_req[slot] = req
         self._slot_len[slot] = n + 1   # prompt + the first generated token's kv-to-be
         self._sampling_dirty = True
@@ -1085,6 +1249,9 @@ class ServingEngine:
                 self.params, self.state, k1, temps_d, top_ks_d, top_ps_d, k,
             )
         self.sync_stats["chunks"] += 1
+        for _slot, req in self._active_requests():
+            if req.trace is not None:
+                req.trace.decode_chunks += 1
         # Start the device→host DMA of the token block now: by the time
         # _flush_inflight wants it (after the NEXT chunk is dispatched), the
         # copy has overlapped device compute instead of serializing with it.
@@ -1113,6 +1280,16 @@ class ServingEngine:
                 self._slot_len[slot] = base + chunk.k
 
     def _emit(self, req: Request, token: int):
+        now = time.monotonic()
+        if not req.generated:
+            req.first_token_at = now
+            self._m_ttft.observe(now - req.submitted_at)
+            if req.trace is not None:
+                req.trace.event("first_token")
+        elif req.last_token_at:
+            self._m_itl.observe(now - req.last_token_at)
+        req.last_token_at = now
+        self._m_tokens.inc()
         req.generated.append(token)
         finished = (
             token in self.eos_ids
@@ -1137,6 +1314,9 @@ class ServingEngine:
         )
         with self._lock:
             self._requests.pop(req.id, None)
+        self._observe_terminal(
+            req, "timeout" if timed_out else
+            "cancelled" if cancelled else "ok")
         if (cancelled or timed_out) and req.emit:
             # Streaming consumers need a terminal event on their channel;
             # cancellation/expiry produces no token, so the sentinel is

@@ -5,7 +5,9 @@ resolution, idempotent setup, noop mode."""
 from __future__ import annotations
 
 import io
+import json
 import logging
+import os
 import re
 
 from kukeon_tpu.runtime import logging_setup
@@ -70,3 +72,68 @@ class TestReformat:
             logging.getLogger("kukeon.e").exception("it failed")
         out = buf.getvalue()
         assert '"it failed"' in out and "ValueError: boom" in out
+
+
+class TestJsonFormat:
+    """KUKEON_LOG_FORMAT=json: one JSON object per line with correlation
+    fields (request_id/cell/phase) matching the trace spans' ids."""
+
+    def test_env_selects_json_and_line_shape(self):
+        _fresh_root()
+        buf = io.StringIO()
+        os.environ["KUKEON_LOG_FORMAT"] = "json"
+        try:
+            logging_setup.setup("info", stream=buf)
+            logging.getLogger("kukeon.serving.engine").info(
+                "request %d ok", 7,
+                extra={"request_id": 7, "phase": "ok"})
+        finally:
+            del os.environ["KUKEON_LOG_FORMAT"]
+        obj = json.loads(buf.getvalue().strip())
+        assert obj["level"] == "INFO"
+        assert obj["msg"] == "request 7 ok"
+        assert obj["logger"] == "kukeon.serving.engine"
+        assert obj["request_id"] == 7 and obj["phase"] == "ok"
+        assert re.match(r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3}Z$",
+                        obj["ts"])
+
+    def test_cell_field_from_runner_env(self):
+        _fresh_root()
+        buf = io.StringIO()
+        os.environ["KUKEON_CELL"] = "llm-0"
+        try:
+            logging_setup.setup("info", stream=buf, fmt="json")
+            logging.getLogger("kukeon.x").info("hello")
+        finally:
+            del os.environ["KUKEON_CELL"]
+        assert json.loads(buf.getvalue().strip())["cell"] == "llm-0"
+
+    def test_multiline_exception_stays_one_line(self):
+        _fresh_root()
+        buf = io.StringIO()
+        logging_setup.setup("info", stream=buf, fmt="json")
+        try:
+            raise RuntimeError("boom\nwith newline")
+        except RuntimeError:
+            logging.getLogger("kukeon.e").exception("failed")
+        lines = [ln for ln in buf.getvalue().splitlines() if ln]
+        assert len(lines) == 1, "a JSON record must never span lines"
+        obj = json.loads(lines[0])
+        assert "RuntimeError: boom" in obj["exc"]
+
+    def test_plain_text_remains_default(self):
+        _fresh_root()
+        buf = io.StringIO()
+        assert "KUKEON_LOG_FORMAT" not in os.environ
+        logging_setup.setup("info", stream=buf)
+        logging.getLogger("kukeon.y").info("plain")
+        line = buf.getvalue().strip()
+        assert '"plain"' in line and not line.startswith("{")
+
+    def test_resetup_switches_format(self):
+        _fresh_root()
+        buf = io.StringIO()
+        logging_setup.setup("info", stream=buf)
+        logging_setup.setup("info", stream=buf, fmt="json")
+        logging.getLogger("kukeon.z").info("switched")
+        assert json.loads(buf.getvalue().strip())["msg"] == "switched"
