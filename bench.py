@@ -239,6 +239,21 @@ def phase_serve(args) -> None:
         total_tokens = sum(len(r.generated) for r in reqs)
         rates.append(total_tokens / dt)
     rates.sort()
+    # Device-layer facts ride along with every serve measurement: compile
+    # counts by program (an unexpected steady-state retrace shows up as a
+    # moving decode count between artifacts) and peak HBM (headroom for
+    # slot-count / context-length tuning). Both read from the engine's own
+    # obs instruments; peak is None on backends without memory stats (CPU).
+    compiles = {p: engine.compiles.count(p)
+                for p in ("prefill", "insert", "decode")}
+    peak_hbm = None
+    for d in jax.devices():
+        try:
+            ms = d.memory_stats()
+        except Exception:  # noqa: BLE001
+            ms = None
+        if ms and "peak_bytes_in_use" in ms:
+            peak_hbm = max(peak_hbm or 0, int(ms["peak_bytes_in_use"]))
     print(json.dumps({
         "backend": backend,
         "n_chips": n_chips,
@@ -248,6 +263,8 @@ def phase_serve(args) -> None:
         "tok_per_s": rates[len(rates) // 2],
         "trials": [round(r, 1) for r in rates],
         "latency_s": latency_percentiles(lat_base),
+        "compiles": compiles,
+        "peak_hbm_bytes": peak_hbm,
         "config": {
             "decode_chunk": engine.decode_chunk,
             "kv_cache_int8": engine.kv_cache_int8,
@@ -612,6 +629,11 @@ def main() -> None:
                     default=os.environ.get("KUKEON_BENCH_KV_INT8", "") == "1")
     # Comma-separated prefill bucket ladder override (e.g. "256,1024,4096").
     ap.add_argument("--prefill-buckets", default=None)
+    # Standardized trajectory artifact (e.g. --out BENCH_r06.json): one
+    # schema-versioned JSON file per run with percentiles, throughput,
+    # compile counts, and peak HBM, so BENCH_*.json points stay comparable
+    # across rounds regardless of how the console line evolves.
+    ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
     if args.autotune or args.phase == "autotune":
@@ -758,7 +780,38 @@ def main() -> None:
                 }
         except (OSError, ValueError):
             pass
+    if args.out:
+        write_artifact(args.out, serve, result)
     print(json.dumps(result))
+
+
+def write_artifact(path: str, serve: dict, result: dict) -> None:
+    """The standardized BENCH_rNN.json trajectory point: fixed schema, one
+    file per run, every field from the product's own instruments."""
+    artifact = {
+        "schema": "kukeon-bench/v1",
+        "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "backend": serve["backend"],
+        "n_chips": serve["n_chips"],
+        "model": serve.get("model_id") or serve["model"],
+        "sessions": serve["sessions"],
+        "tok_per_s": round(serve["tok_per_s"], 2),
+        "trials": serve["trials"],
+        "vs_baseline": result.get("vs_baseline"),
+        # p50/p95/p99 for ttft / inter_token / e2e (engine histograms).
+        "latency_s": serve.get("latency_s"),
+        "compiles": serve.get("compiles"),
+        "peak_hbm_bytes": serve.get("peak_hbm_bytes"),
+        "cold_start": result.get("cold_start"),
+        "embedding": result.get("embedding"),
+    }
+    try:
+        with open(path, "w") as f:
+            json.dump(artifact, f, indent=1)
+            f.write("\n")
+        _log(f"wrote trajectory artifact {path}")
+    except OSError as e:
+        _log(f"could not write {path}: {e}")
 
 
 if __name__ == "__main__":

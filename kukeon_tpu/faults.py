@@ -57,6 +57,7 @@ POINTS = (
     "checkpoint.save",
     "checkpoint.load",
     "devices.probe_wedged",
+    "profile.capture",
 )
 
 

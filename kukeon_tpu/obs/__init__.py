@@ -25,3 +25,10 @@ from kukeon_tpu.obs.registry import (  # noqa: F401
 )
 from kukeon_tpu.obs.expo import faults_collector, render  # noqa: F401
 from kukeon_tpu.obs.trace import Span, Tracer  # noqa: F401
+from kukeon_tpu.obs.device import (  # noqa: F401
+    CompileTracker,
+    ProfileBusy,
+    ProfileSpool,
+    device_memory_collector,
+)
+from kukeon_tpu.obs.slo import SloObjectives, SloTracker  # noqa: F401

@@ -38,9 +38,17 @@ def _fmt_le(b: float) -> str:
 
 def render(registry) -> str:
     """The full exposition for one registry: declared metrics first
-    (sorted by name), then every registered collector's families."""
+    (sorted by name), then every registered collector's families. The
+    scrape-error counter renders LAST so a callable that fails during THIS
+    scrape is already visible in it (ordering by name would render the
+    counter before most gauges evaluate)."""
     out: list[str] = []
+    err_counter = getattr(registry, "scrape_errors", None)
+    deferred = None
     for m in registry.metrics():
+        if m is err_counter:
+            deferred = m
+            continue
         out.append(f"# HELP {m.name} {m.help}".rstrip())
         out.append(f"# TYPE {m.name} {m.kind}")
         if m.kind == "histogram":
@@ -51,11 +59,30 @@ def render(registry) -> str:
         ):
             out.append(f"{m.name}{_labels_str(labels)} {_fmt(value)}")
     for fn in registry.collectors():
-        for name, kind, help, samples in fn():
-            out.append(f"# HELP {name} {help}".rstrip())
-            out.append(f"# TYPE {name} {kind}")
-            for labels, value in samples:
-                out.append(f"{name}{_labels_str(labels)} {_fmt(value)}")
+        # One raising collector skips only its own families: the rest of
+        # the exposition still renders and the failure is counted on
+        # kukeon_scrape_errors_total (same scrape-robustness contract the
+        # Gauge callables follow).
+        lines: list[str] = []
+        try:
+            for name, kind, help, samples in fn():
+                lines.append(f"# HELP {name} {help}".rstrip())
+                lines.append(f"# TYPE {name} {kind}")
+                for labels, value in samples:
+                    lines.append(f"{name}{_labels_str(labels)} {_fmt(value)}")
+        except Exception:  # noqa: BLE001 — a dead collector must not kill the scrape
+            err = getattr(registry, "scrape_errors", None)
+            if err is not None:
+                err.inc(metric=getattr(fn, "__qualname__", "collector"))
+            continue
+        out.extend(lines)
+    if deferred is not None:
+        out.append(f"# HELP {deferred.name} {deferred.help}".rstrip())
+        out.append(f"# TYPE {deferred.name} {deferred.kind}")
+        for labels, value in sorted(
+            deferred.samples(), key=lambda s: sorted(s[0].items())
+        ):
+            out.append(f"{deferred.name}{_labels_str(labels)} {_fmt(value)}")
     return "\n".join(out) + "\n"
 
 

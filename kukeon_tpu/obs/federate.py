@@ -1,0 +1,139 @@
+"""Fleet federation: parse, relabel, and merge Prometheus text expositions.
+
+The daemon scrapes every running model cell's ``GET /metrics`` and
+re-exposes the union with a ``cell="realm/space/stack/name"`` label on every
+sample, so one scrape of the daemon sees the whole host's serving fleet.
+This module is the text machinery: a strict line parser for the subset of
+the format ``expo.render`` emits (it IS the in-repo format, pinned by the
+golden test), label injection, and family-grouped re-rendering (samples of
+one family from many cells must land under a single TYPE declaration).
+
+Parsing is strict — a cell emitting garbage is treated as a failed scrape
+(``kukeon_cell_scrape_ok 0``) rather than corrupting the merged exposition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+from kukeon_tpu.obs import expo
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{.*\})?'
+    r' (-?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|\+Inf|-Inf|NaN))$'
+)
+_LABEL_RE = re.compile(r'([a-zA-Z0-9_]+)="((?:[^"\\]|\\.)*)"')
+_SUFFIX_RE = re.compile(r"_(bucket|sum|count)$")
+
+
+@dataclasses.dataclass
+class Family:
+    name: str
+    kind: str = "untyped"
+    help: str = ""
+    # (sample name incl. _bucket/_sum/_count suffix, labels, value string)
+    samples: list[tuple[str, dict[str, str], str]] = dataclasses.field(
+        default_factory=list)
+
+
+def parse(text: str) -> dict[str, Family]:
+    """Exposition text -> ordered {family name: Family}. Raises ValueError
+    on any line the in-repo renderer could not have produced."""
+    families: dict[str, Family] = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            name = parts[2]
+            fam = families.setdefault(name, Family(name))
+            fam.help = parts[3] if len(parts) > 3 else ""
+        elif line.startswith("# TYPE "):
+            _h, _t, name, kind = line.split(None, 3)
+            if kind not in ("counter", "gauge", "histogram", "untyped"):
+                raise ValueError(f"unknown metric type in {line!r}")
+            families.setdefault(name, Family(name)).kind = kind
+        elif line.startswith("#"):
+            continue
+        else:
+            m = _SAMPLE_RE.match(line)
+            if not m:
+                raise ValueError(f"malformed sample line {line!r}")
+            sample_name = m.group(1)
+            fam = families.get(sample_name) or families.get(
+                _SUFFIX_RE.sub("", sample_name))
+            if fam is None:
+                raise ValueError(
+                    f"sample before family declaration: {line!r}")
+            labels: dict[str, str] = {}
+            if m.group(2):
+                labels = {k: v for k, v in _LABEL_RE.findall(m.group(2))}
+            fam.samples.append((sample_name, labels, m.group(3)))
+    return families
+
+
+def inject_label(families: dict[str, Family], **labels: str) -> None:
+    """Add label(s) to every sample in place (the ``cell=`` relabel)."""
+    for fam in families.values():
+        fam.samples = [
+            (name, {**lab, **{k: str(v) for k, v in labels.items()}}, value)
+            for name, lab, value in fam.samples
+        ]
+
+
+def render(families: dict[str, Family]) -> str:
+    """Families -> exposition text (one HELP/TYPE per family, samples
+    grouped under it; the inverse of :func:`parse`)."""
+    out: list[str] = []
+    for fam in families.values():
+        out.append(f"# HELP {fam.name} {fam.help}".rstrip())
+        out.append(f"# TYPE {fam.name} {fam.kind}")
+        for name, labels, value in fam.samples:
+            out.append(f"{name}{expo._labels_str(labels)} {value}")
+    return "\n".join(out) + "\n"
+
+
+def merge(parts: list[dict[str, Family]]) -> dict[str, Family]:
+    """Union of several parsed expositions, first-seen HELP/TYPE winning,
+    samples concatenated in part order."""
+    merged: dict[str, Family] = {}
+    for families in parts:
+        for name, fam in families.items():
+            tgt = merged.get(name)
+            if tgt is None:
+                merged[name] = Family(name, fam.kind, fam.help,
+                                      list(fam.samples))
+            else:
+                tgt.samples.extend(fam.samples)
+    return merged
+
+
+def histogram_counts(fam: Family, **match: str
+                     ) -> tuple[tuple[float, ...], list[int]]:
+    """(finite bucket bounds, per-bucket counts + overflow slot) recovered
+    from a parsed histogram family's cumulative ``_bucket`` samples,
+    restricted to samples whose labels include ``match``. The return shape
+    feeds ``obs.percentile_from_counts`` directly."""
+    rows: list[tuple[float, float]] = []
+    inf = 0.0
+    for name, labels, value in fam.samples:
+        if not name.endswith("_bucket"):
+            continue
+        if any(labels.get(k) != v for k, v in match.items()):
+            continue
+        le = labels.get("le", "")
+        if le == "+Inf":
+            inf = float(value)
+        else:
+            rows.append((float(le), float(value)))
+    rows.sort()
+    bounds = tuple(le for le, _ in rows)
+    counts: list[int] = []
+    prev = 0.0
+    for _le, cum in rows:
+        counts.append(int(cum - prev))
+        prev = cum
+    counts.append(int(inf - prev))
+    return bounds, counts

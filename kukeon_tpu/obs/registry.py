@@ -54,6 +54,9 @@ class _Metric:
         self.help = help
         self.label_names = tuple(label_names)
         self._lock = lock
+        # Wired by the owning Registry: the scrape-error counter a failing
+        # scrape-time callable reports to (None for the counter itself).
+        self._scrape_errors: "Counter | None" = None
 
     def samples(self) -> list[tuple[dict[str, str], float]]:
         """(labels, value) pairs for exposition (flat metrics only)."""
@@ -136,7 +139,11 @@ class Gauge(_Metric):
             try:
                 items[key] = float(fn())
             except Exception:  # noqa: BLE001 — a dead callback must not kill the scrape
+                # Skip the sample but make the failure visible: a silently
+                # vanishing gauge looks identical to "never set".
                 items.pop(key, None)
+                if self._scrape_errors is not None:
+                    self._scrape_errors.inc(metric=self.name)
         return [(dict(zip(self.label_names, k)), v)
                 for k, v in items.items()]
 
@@ -196,10 +203,16 @@ def percentile_from_counts(buckets: tuple[float, ...], counts: list[int],
 
     Module-level so callers holding a count DELTA (bench.py subtracts a
     pre-measurement snapshot to keep warmup compiles out of the reported
-    percentiles) share the exact estimator the live histogram uses."""
+    percentiles) share the exact estimator the live histogram uses.
+
+    Edge contracts (unit-tested): an empty histogram returns the None
+    sentinel — never a fabricated 0.0 that would read as "instant" on a
+    dashboard; q is clamped into [0, 1]; observations past the top finite
+    bucket clamp to that bound instead of extrapolating."""
     n = sum(counts)
     if n == 0:
         return None
+    q = min(1.0, max(0.0, float(q)))
     rank = q * n
     seen = 0
     for i, c in enumerate(counts[:-1]):
@@ -219,6 +232,14 @@ class Registry:
         self._lock = threading.Lock()
         self._metrics: dict[str, _Metric] = {}
         self._collectors: list[Callable[[], Iterable]] = []
+        # Scrape-robustness accounting: a gauge callable or collector that
+        # raises at scrape time is skipped — and counted here — instead of
+        # 500ing the whole exposition (one bad callback must not blind the
+        # operator to every other metric).
+        self.scrape_errors = self.counter(
+            "kukeon_scrape_errors_total",
+            "Scrape-time callables (gauge functions, collectors) that "
+            "raised; their samples were skipped.", labels=("metric",))
 
     def _get_or_create(self, cls, name: str, help: str,
                        label_names: Iterable[str], **kw) -> _Metric:
@@ -233,6 +254,7 @@ class Registry:
                     )
                 return m
             m = cls(name, help, label_names, self._lock, **kw)
+            m._scrape_errors = getattr(self, "scrape_errors", None)
             self._metrics[name] = m
             return m
 

@@ -118,6 +118,15 @@ class Tracer:
             spans = list(self._done)
         return [s.to_dict() for s in reversed(spans[-max(0, n):])]
 
+    def for_request(self, request_id: int) -> list[dict]:
+        """Exact-match lookup by request id (``GET /v1/trace?request_id=``):
+        a slow request found in the logs can be pulled directly instead of
+        paging the tail and eyeballing. Newest-first; normally one span,
+        but shed spans share id -1."""
+        with self._lock:
+            spans = [s for s in self._done if s.request_id == request_id]
+        return [s.to_dict() for s in reversed(spans)]
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._done)

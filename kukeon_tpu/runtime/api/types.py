@@ -181,6 +181,13 @@ class ModelSpec:
     # wins). Expired requests get an in-band timeout terminal event and
     # free their slot. None/0 = no default deadline.
     deadline_s: float | None = None
+    # Serving objectives (obs/slo.py): the cell evaluates availability and
+    # TTFT burn rates against these at scrape time and exposes them as
+    # kukeon_slo_* on /metrics. sloTtftP95Ms bounds the 95th-percentile
+    # time-to-first-token (milliseconds); sloAvailability is the required
+    # success fraction (e.g. 0.999). Unset = the cell's loose defaults.
+    slo_ttft_p95_ms: float | None = None
+    slo_availability: float | None = None
     # Model cells live INSIDE the space network by default: the server binds
     # the cell's bridge IP, in-space agent cells reach it there, and the
     # space's default-deny egress governs its traffic (BASELINE config 4).

@@ -250,6 +250,15 @@ def validate_cell(spec: t.CellSpec, ctx: str, *, in_blueprint: bool = False) -> 
             raise InvalidArgument(
                 f"{ctx}: model.dtype must be one of {_MODEL_DTYPES}, got {m.dtype!r}"
             )
+        if m.slo_ttft_p95_ms is not None and m.slo_ttft_p95_ms <= 0:
+            raise InvalidArgument(
+                f"{ctx}: model.sloTtftP95Ms must be > 0"
+            )
+        if m.slo_availability is not None and not (
+                0.0 < m.slo_availability < 1.0):
+            raise InvalidArgument(
+                f"{ctx}: model.sloAvailability must be a fraction in (0, 1)"
+            )
 
 
 def validate_space(spec: t.SpaceSpec, ctx: str) -> None:

@@ -3,7 +3,7 @@
 Verbs: init, daemon (serve/start/stop/kill/restart/status/logs/metrics),
 apply,
 create, delete, get, run, start, stop, kill, attach, log, purge, refresh,
-status, doctor, image, build, team, uninstall, version, autocomplete.
+status, top, doctor, image, build, team, uninstall, version, autocomplete.
 
 Workload verbs route to the daemon; read/maintenance verbs "promote" to an
 in-process controller when --no-daemon / KUKEON_NO_DAEMON is set (reference
@@ -637,6 +637,60 @@ def cmd_status(args):
         return 1
 
 
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "K", "M", "G", "T"):
+        if abs(n) < 1024 or unit == "T":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}T"
+
+
+def _fmt_ms(s) -> str:
+    return "-" if s is None else f"{s * 1000:.0f}ms"
+
+
+def cmd_top(args):
+    """One-screen fleet view from a single federated scrape: the daemon
+    pulls every running model cell's /metrics (ScrapeCells) and this
+    renders the per-cell table — ready, QPS, TTFT p50/p95, queue depth,
+    HBM, restarts. Unreachable cells show their scrape error instead of
+    silently vanishing."""
+    try:
+        out = _client(args).call("ScrapeCells")
+    except KukeonError as e:
+        print(f"daemon unreachable: {e}", file=sys.stderr)
+        return 1
+    rows = out.get("cells", [])
+    if args.json:
+        _print(rows, True)
+        return 0
+    if not rows:
+        print("no running model cells")
+        return 0
+    fmt = "{:<32} {:<8} {:<6} {:>7} {:>8} {:>8} {:>6} {:>14} {:>9}"
+    print(fmt.format("CELL", "MODEL", "READY", "QPS", "P50TTFT", "P95TTFT",
+                     "QUEUE", "HBM", "RESTARTS"))
+    for r in rows:
+        if not r.get("ok"):
+            print(fmt.format(r["cell"], "-", "down", "-", "-", "-", "-",
+                             "-", r.get("restarts", 0))
+                  + f"  ({r.get('error', 'scrape failed')})")
+            continue
+        hbm = "-"
+        if r.get("hbmInUseBytes") is not None:
+            hbm = (f"{_fmt_bytes(r['hbmInUseBytes'])}"
+                   f"/{_fmt_bytes(r.get('hbmLimitBytes'))}")
+        print(fmt.format(
+            r["cell"], r.get("model") or "-",
+            "yes" if r.get("ready") else "no",
+            f"{r['qps']:.1f}" if r.get("qps") is not None else "-",
+            _fmt_ms(r.get("ttftP50S")), _fmt_ms(r.get("ttftP95S")),
+            r.get("queueDepth", "-"), hbm, r.get("restarts", 0)))
+    return 0
+
+
 def cmd_doctor(args):
     """Host pre-flight checks (reference: kuke doctor / internal/cgroupcheck:
     controller availability + delegation detail; all five native tools; the
@@ -739,7 +793,7 @@ _BASH_COMPLETION = """\
 _kuke_complete() {
     local cur="${COMP_WORDS[COMP_CWORD]}" prev="${COMP_WORDS[COMP_CWORD-1]}"
     local verbs="init apply create build daemon get delete doctor start status \
-stop team kill purge refresh run attach log autocomplete image uninstall version"
+stop team kill purge refresh run attach log top autocomplete image uninstall version"
     if [ "$COMP_CWORD" -eq 1 ]; then
         COMPREPLY=($(compgen -W "$verbs" -- "$cur")); return
     fi
@@ -900,6 +954,7 @@ def build_parser() -> argparse.ArgumentParser:
     _scope_args(sp)
 
     sub_add("status")
+    sub_add("top")
     sub_add("doctor")
     sub_add("refresh")
 
@@ -968,6 +1023,7 @@ HANDLERS = {
     "attach": cmd_attach,
     "log": cmd_log,
     "status": cmd_status,
+    "top": cmd_top,
     "doctor": cmd_doctor,
     "refresh": cmd_refresh,
     "purge": cmd_purge,

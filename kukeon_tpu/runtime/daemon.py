@@ -21,6 +21,8 @@ import threading
 import time
 import traceback
 
+from kukeon_tpu.obs import federate as fed
+from kukeon_tpu.obs import percentile_from_counts
 from kukeon_tpu.runtime import consts
 from kukeon_tpu.runtime.api import types as t
 from kukeon_tpu.runtime.apply import parser
@@ -39,6 +41,134 @@ from kukeon_tpu.runtime.runner import Runner
 from kukeon_tpu.runtime.store import ResourceStore
 
 PROTOCOL_VERSION = "v1"
+
+# Per-cell /metrics scrape budget for fleet federation (seconds). One hung
+# cell must cost the federated scrape at most this long, never block it.
+SCRAPE_TIMEOUT_ENV = "KUKEON_SCRAPE_TIMEOUT_S"
+DEFAULT_SCRAPE_TIMEOUT_S = 2.0
+
+
+def model_cell_endpoints(ctl) -> list[tuple[str, str, dict]]:
+    """(cell key, base url, record) for every running model cell.
+
+    The endpoint is the cell's bridge IP when the space network attached
+    one, else the host loopback (hostNetwork cells and the process backend
+    both bind there)."""
+    out: list[tuple[str, str, dict]] = []
+    for realm in ctl.list_realms():
+        for rec in ctl.list_cells(realm):
+            m = (rec.get("spec") or {}).get("model")
+            if not m:
+                continue
+            st = rec.get("status") or {}
+            if st.get("phase") not in ("ready", "degraded"):
+                continue
+            host = st.get("ip") or "127.0.0.1"
+            key = "/".join((rec["realm"], rec["space"], rec["stack"],
+                            rec["name"]))
+            out.append((key, f"http://{host}:{m.get('port', 9000)}", rec))
+    return out
+
+
+def scrape_fleet(ctl, timeout_s: float | None = None) -> list[dict]:
+    """Pull every running model cell's /metrics concurrently, each under
+    its own timeout. Never raises: an unreachable or garbage-emitting cell
+    yields ``ok: False`` with the error, and the pass carries on — one dead
+    cell must not blind the operator to the rest of the fleet."""
+    import urllib.request
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get(SCRAPE_TIMEOUT_ENV, "") or
+                          DEFAULT_SCRAPE_TIMEOUT_S)
+    cells = model_cell_endpoints(ctl)
+    results: list[dict | None] = [None] * len(cells)
+
+    def work(i: int, key: str, url: str, rec: dict) -> None:
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(url + "/metrics",
+                                        timeout=timeout_s) as r:
+                text = r.read().decode()
+            results[i] = {"cell": key, "url": url, "record": rec,
+                          "ok": True, "families": fed.parse(text),
+                          "elapsedS": round(time.monotonic() - t0, 4)}
+        except Exception as e:  # noqa: BLE001 — a dead cell is a data point, not a failure
+            results[i] = {"cell": key, "url": url, "record": rec,
+                          "ok": False, "error": f"{type(e).__name__}: {e}",
+                          "elapsedS": round(time.monotonic() - t0, 4)}
+
+    threads = [threading.Thread(target=work, args=(i, key, url, rec),
+                                daemon=True, name=f"scrape-{key}")
+               for i, (key, url, rec) in enumerate(cells)]
+    for t in threads:
+        t.start()
+    # urllib's timeout bounds connect and each read separately; the join
+    # backstop keeps a pathological socket from wedging the whole pass.
+    deadline = time.monotonic() + timeout_s * 2 + 1.0
+    for t in threads:
+        t.join(timeout=max(0.1, deadline - time.monotonic()))
+    return [
+        r if r is not None else
+        {"cell": key, "url": url, "record": rec, "ok": False,
+         "error": f"scrape did not finish within {timeout_s * 2 + 1.0:.1f}s",
+         "elapsedS": timeout_s}
+        for r, (key, url, rec) in zip(results, cells)
+    ]
+
+
+def _sample_value(fams: dict, name: str, **match) -> float | None:
+    fam = fams.get(name)
+    if fam is None:
+        return None
+    for _n, labels, value in fam.samples:
+        if all(labels.get(k) == v for k, v in match.items()):
+            return float(value)
+    return None
+
+
+def _sample_sum(fams: dict, name: str) -> float | None:
+    fam = fams.get(name)
+    if fam is None or not fam.samples:
+        return None
+    return sum(float(v) for _n, _l, v in fam.samples)
+
+
+def summarize_cell_scrape(fams: dict) -> dict:
+    """One cell's scraped families -> the `kuke top` row fields."""
+    out: dict = {}
+    info = fams.get("kukeon_cell_info")
+    if info is not None and info.samples:
+        out["model"] = info.samples[0][1].get("model")
+    ready = _sample_value(fams, "kukeon_cell_ready")
+    if ready is not None:
+        out["ready"] = bool(ready)
+    uptime = _sample_value(fams, "kukeon_cell_uptime_seconds")
+    total = _sample_sum(fams, "kukeon_engine_requests_total")
+    if uptime and total is not None:
+        # Single-scrape QPS is necessarily the lifetime average; rate-over-
+        # window lives in Prometheus once the federated scrape lands there.
+        out["qps"] = round(total / max(uptime, 1e-9), 3)
+    q = _sample_value(fams, "kukeon_engine_queue_depth")
+    if q is not None:
+        out["queueDepth"] = int(q)
+    ttft = fams.get("kukeon_engine_ttft_seconds")
+    if ttft is not None:
+        bounds, counts = fed.histogram_counts(ttft)
+        if bounds and sum(counts):
+            out["ttftP50S"] = round(
+                percentile_from_counts(bounds, counts, 0.5), 5)
+            out["ttftP95S"] = round(
+                percentile_from_counts(bounds, counts, 0.95), 5)
+    for key, name in (("hbmInUseBytes", "kukeon_hbm_bytes_in_use"),
+                      ("hbmLimitBytes", "kukeon_hbm_bytes_limit")):
+        v = _sample_sum(fams, name)
+        if v is not None:
+            out[key] = int(v)
+    burn = _sample_value(fams, "kukeon_slo_burn_rate",
+                         slo="availability", window="1h")
+    if burn is not None:
+        out["sloBurn1h"] = round(burn, 4)
+    return out
 
 
 def build_controller(run_path: str,
@@ -328,15 +458,57 @@ class RPCService:
     def ReconcileNow(self) -> dict:
         return self.ctl.reconcile_cells()
 
-    def Metrics(self) -> dict:
-        """Prometheus text exposition of the daemon process: RPC traffic,
-        reconcile-loop activity, and the runner's cell-lifecycle metrics
-        (starts/restarts/exit codes/backoff/uptime). The CLI surfaces it
-        as `kuke daemon metrics`."""
+    def Metrics(self, federate: bool = True) -> dict:
+        """Prometheus text exposition of the daemon process — RPC traffic,
+        reconcile-loop activity, the runner's cell-lifecycle metrics —
+        UNIONED with every running model cell's own /metrics, each cell's
+        samples labelled ``cell="realm/space/stack/name"``. One daemon
+        scrape sees the whole host's serving fleet; an unreachable cell is
+        marked ``kukeon_cell_scrape_ok{cell=} 0`` instead of failing the
+        scrape. The CLI surfaces it as `kuke daemon metrics`."""
         from kukeon_tpu.obs import expo
 
+        own_text = expo.render(self.ctl.runner.registry)
+        if not federate:
+            return {"contentType": expo.CONTENT_TYPE, "text": own_text}
+        scrapes = scrape_fleet(self.ctl)
+        if not scrapes:
+            return {"contentType": expo.CONTENT_TYPE, "text": own_text}
+        parts = [fed.parse(own_text)]
+        for s in scrapes:
+            if s["ok"]:
+                fed.inject_label(s["families"], cell=s["cell"])
+                parts.append(s["families"])
+        merged = fed.merge(parts)
+        merged["kukeon_cell_scrape_ok"] = fed.Family(
+            "kukeon_cell_scrape_ok", "gauge",
+            "1 when this pass scraped the cell's /metrics; 0 marks a "
+            "stale/unreachable cell.",
+            [("kukeon_cell_scrape_ok", {"cell": s["cell"]},
+              "1" if s["ok"] else "0") for s in scrapes])
         return {"contentType": expo.CONTENT_TYPE,
-                "text": expo.render(self.ctl.runner.registry)}
+                "text": fed.render(merged)}
+
+    def ScrapeCells(self, timeoutS: float | None = None) -> dict:
+        """One federated pass over the fleet, summarized per cell for
+        `kuke top`: readiness, lifetime QPS, TTFT p50/p95, queue depth,
+        HBM in-use/limit, restart counts — all read from each cell's own
+        /metrics plus the daemon's records, never a second bookkeeping
+        path."""
+        rows = []
+        for s in scrape_fleet(self.ctl, timeoutS):
+            rec = s["record"]
+            row = {"cell": s["cell"], "url": s["url"], "ok": s["ok"],
+                   "phase": (rec.get("status") or {}).get("phase"),
+                   "restarts": sum(
+                       c.get("restarts", 0) for c in
+                       (rec.get("status") or {}).get("containers", []))}
+            if s["ok"]:
+                row.update(summarize_cell_scrape(s["families"]))
+            else:
+                row["error"] = s["error"]
+            rows.append(row)
+        return {"cells": rows}
 
     def Status(self) -> dict:
         ms = self.ctl.store.ms

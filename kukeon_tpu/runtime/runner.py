@@ -280,6 +280,10 @@ class Runner:
             cmd += ["--max-pending", str(m.max_pending)]
         if m.deadline_s:
             cmd += ["--deadline-s", str(m.deadline_s)]
+        if m.slo_ttft_p95_ms:
+            cmd += ["--slo-ttft-p95-ms", str(m.slo_ttft_p95_ms)]
+        if m.slo_availability:
+            cmd += ["--slo-availability", str(m.slo_availability)]
         return t.ContainerSpec(
             name="model-server",
             command=cmd,

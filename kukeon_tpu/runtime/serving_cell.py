@@ -17,7 +17,11 @@ the mesh over whatever devices JAX exposes and serves:
                         prefill-by-bucket), shed/timeout/watchdog/fault
                         counters, slot/queue gauges (kukeon_tpu/obs)
   GET  /v1/trace?n=K -> newest K per-request trace spans (lifecycle events
-                        + per-phase durations summing to e2e)
+                        + per-phase durations summing to e2e);
+                        ?request_id=N pulls one request's span exactly
+  POST /v1/profile   -> {"durationMs": N} starts a single-flight
+                        jax.profiler capture into KUKEON_PROFILE_DIR
+                        (409 while one runs); GET /v1/profile lists captures
   POST /v1/generate  -> {"promptTokens": [...] | "prompt": "text",
                          "maxNewTokens": N, "temperature": T,
                          "deadlineS": D, ...}
@@ -46,7 +50,15 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from kukeon_tpu import faults
-from kukeon_tpu.obs import Registry, expo
+from kukeon_tpu.obs import (
+    ProfileBusy,
+    ProfileSpool,
+    Registry,
+    SloObjectives,
+    SloTracker,
+    device_memory_collector,
+    expo,
+)
 from kukeon_tpu.serving.engine import DeadlineExceeded, RejectedError
 
 MODELS = {}
@@ -116,6 +128,13 @@ class LifecycleMixin:
             "kukeon_watchdog_trips_total",
             "Wedged verdicts (the cell exits for restart right after).")
         registry.register_collector(expo.faults_collector)
+        # Device telemetry on every cell flavor (register_collector dedupes,
+        # so the decoder cell — whose engine already registered the same
+        # collector on the shared registry — emits the families once).
+        registry.register_collector(device_memory_collector)
+        # On-demand profiler spool behind POST/GET /v1/profile: single-
+        # flight jax.profiler captures into KUKEON_PROFILE_DIR, keep-last-K.
+        self.profiler = ProfileSpool(registry=registry)
 
     def mark_ready(self):
         self.unready_reason = None
@@ -272,7 +291,9 @@ class ServingCell(LifecycleMixin):
                  kv_cache_int8: bool | None = None,
                  decode_chunk: int | None = None,
                  max_pending: int | None = None,
-                 deadline_s: float | None = None):
+                 deadline_s: float | None = None,
+                 slo_ttft_p95_ms: float | None = None,
+                 slo_availability: float | None = None):
         import jax
 
         _enable_compilation_cache()
@@ -379,6 +400,17 @@ class ServingCell(LifecycleMixin):
         self.default_deadline_s = deadline_s
         self._init_lifecycle()
         self._init_cell_obs(registry, kind="decoder")
+        # SLO layer (obs/slo.py): burn rates + error-budget gauges computed
+        # at scrape time from the engine's own requests/TTFT instruments.
+        # Unset objectives fall back to the loose defaults so every cell
+        # exposes the kukeon_slo_* families with a stable schema.
+        d = SloObjectives()
+        self.slo = SloTracker(registry, SloObjectives(
+            availability=(slo_availability if slo_availability
+                          else d.availability),
+            ttft_p95_ms=(slo_ttft_p95_ms if slo_ttft_p95_ms
+                         else d.ttft_p95_ms),
+        ))
 
     @staticmethod
     def _load_checkpoint(path: str, cfg, quantize: bool = False):
@@ -855,12 +887,32 @@ def make_handler(cell: ServingCell):
                     self._send(404, {"error": "this cell records no "
                                               "request traces"})
                     return
+                q = parse_qs(parts.query)
+                if "request_id" in q:
+                    # Exact-match pull: a slow request found in the logs is
+                    # fetched directly instead of paging the ?n=K tail.
+                    try:
+                        rid = int(q["request_id"][0])
+                    except ValueError:
+                        self._send(400,
+                                   {"error": "request_id must be an integer"})
+                        return
+                    self._send(200, {"spans": tracer.for_request(rid)})
+                    return
                 try:
-                    n = int(parse_qs(parts.query).get("n", ["50"])[0])
+                    n = int(q.get("n", ["50"])[0])
                 except ValueError:
                     self._send(400, {"error": "n must be an integer"})
                     return
                 self._send(200, {"spans": tracer.recent(n)})
+            elif path == "/v1/profile":
+                profiler = getattr(cell, "profiler", None)
+                if profiler is None:
+                    self._send(404, {"error": "this cell has no profiler"})
+                    return
+                self._send(200, {"captures": profiler.list(),
+                                 "dir": profiler.base_dir,
+                                 "keep": profiler.keep})
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
@@ -869,6 +921,27 @@ def make_handler(cell: ServingCell):
                 started = (cell.begin_drain()
                            if hasattr(cell, "begin_drain") else False)
                 self._send(200, {"draining": True, "started": started})
+                return
+            if self.path == "/v1/profile":
+                # Start an on-demand device-profile capture. Deliberately
+                # exempt from admission: profiling a draining or overloaded
+                # cell is exactly when an operator wants a trace.
+                profiler = getattr(cell, "profiler", None)
+                if profiler is None:
+                    self._send(404, {"error": "this cell has no profiler"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    rec = profiler.start(float(req.get("durationMs", 1000)))
+                    self._send(200, {"started": True, "capture": rec})
+                except ProfileBusy as e:
+                    # Single-flight: one capture at a time (409 Conflict).
+                    self._send(409, {"error": str(e)})
+                except (ValueError, TypeError) as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — server must keep serving
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
                 return
             routes = {}
             if hasattr(cell, "generate"):
@@ -972,6 +1045,10 @@ def main(argv=None) -> int:
     # slot and answer in-band). 0 disables either.
     ap.add_argument("--max-pending", type=int, default=64)
     ap.add_argument("--deadline-s", type=float, default=0.0)
+    # SLO objectives (ModelSpec sloTtftP95Ms / sloAvailability): drive the
+    # kukeon_slo_* burn-rate gauges on /metrics. 0 = use the loose default.
+    ap.add_argument("--slo-ttft-p95-ms", type=float, default=0.0)
+    ap.add_argument("--slo-availability", type=float, default=0.0)
     args = ap.parse_args(argv)
 
     _register_models()
@@ -989,6 +1066,8 @@ def main(argv=None) -> int:
             kv_cache_int8=args.kv_cache_int8, decode_chunk=args.decode_chunk,
             max_pending=args.max_pending or None,
             deadline_s=args.deadline_s or None,
+            slo_ttft_p95_ms=args.slo_ttft_p95_ms or None,
+            slo_availability=args.slo_availability or None,
         )
         # Warmup before the engine thread starts: step() is single-driver.
         if not args.no_warmup:

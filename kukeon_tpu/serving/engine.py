@@ -50,7 +50,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from kukeon_tpu import faults
 from kukeon_tpu.models import llama
-from kukeon_tpu.obs import Registry, Tracer, faults_collector
+from kukeon_tpu.obs import (
+    CompileTracker,
+    Registry,
+    Tracer,
+    device_memory_collector,
+    faults_collector,
+)
 from kukeon_tpu.parallel import sharding as shd
 from kukeon_tpu.parallel.mesh import set_mesh
 from kukeon_tpu.serving.sampling import (
@@ -436,6 +442,14 @@ class ServingEngine:
         # module, so an engine scrape is complete without a cell wrapper.
         reg.register_collector(self._obs_collect)
         reg.register_collector(faults_collector)
+        # Device-level telemetry (obs/device.py): HBM gauges read from
+        # jax.Device.memory_stats() at scrape time, and compile tracking
+        # around the jitted programs — the docstring's "occupancy changes
+        # never recompile" promise is a measurable invariant
+        # (kukeon_compiles_total{program="decode"} flat after warmup; a
+        # tier-1 test asserts it across slot churn).
+        reg.register_collector(device_memory_collector)
+        self.compiles = CompileTracker(reg)
         # Progress heartbeat for the TPU watchdog: bumped on submit and on
         # every step() that did work. A wedged runtime blocks the driver
         # inside a device call, so this goes stale while work is queued —
@@ -623,11 +637,17 @@ class ServingEngine:
             (state, _), toks = jax.lax.scan(body, (state, key), length=n_steps)
             return state, toks.T  # [B, K]
 
-        self._prefill = jax.jit(prefill)
-        self._prefill_ext = jax.jit(prefill_ext)
-        self._insert = jax.jit(insert, donate_argnums=(0,))
-        self._decode_chunk = jax.jit(
-            decode_chunk_fn, static_argnums=(6,), donate_argnums=(1,)
+        # Every program dispatches through the compile tracker: a dispatch
+        # that grew the jit tracing cache is counted + timed by program
+        # (prefill covers both the cold and prefix-extend variants). The
+        # wrapper forwards .lower/.compile so precompile() is unchanged.
+        ct = self.compiles
+        self._prefill = ct.wrap(jax.jit(prefill), "prefill")
+        self._prefill_ext = ct.wrap(jax.jit(prefill_ext), "prefill")
+        self._insert = ct.wrap(jax.jit(insert, donate_argnums=(0,)), "insert")
+        self._decode_chunk = ct.wrap(
+            jax.jit(decode_chunk_fn, static_argnums=(6,), donate_argnums=(1,)),
+            "decode",
         )
 
     def _bucket(self, n: int) -> int:

@@ -30,10 +30,19 @@ import pytest  # noqa: E402
 
 def pytest_configure(config):
     config.addinivalue_line(
-        "markers", "slow: long-running tests excluded from the tier-1 run")
+        "markers", "slow: long-running tests excluded from the tier-1 run "
+        "(subprocess daemons, real multi-cell federation e2e)")
     config.addinivalue_line(
         "markers",
         "faults: tests that arm KUKEON_FAULTS (the fault-injection harness)")
+
+
+@pytest.fixture(autouse=True)
+def _isolate_profile_spool(tmp_path, monkeypatch):
+    """Point the on-demand profiler spool (KUKEON_PROFILE_DIR) at a per-test
+    temp dir: captures from one test must never satisfy another test's
+    listing, and the shared /tmp default must never accumulate CI garbage."""
+    monkeypatch.setenv("KUKEON_PROFILE_DIR", str(tmp_path / "profiles"))
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,302 @@
+"""Fleet federation (PR 4): the daemon scrapes every running model cell's
+/metrics, re-exposes the union with cell= labels (unreachable cells marked
+via kukeon_cell_scrape_ok 0), summarizes the fleet for `kuke top`, and the
+federate text machinery round-trips the in-repo exposition format."""
+
+from __future__ import annotations
+
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kukeon_tpu import obs
+from kukeon_tpu.obs import Registry, expo
+from kukeon_tpu.obs import federate as fed
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.cells import FakeBackend
+from kukeon_tpu.runtime.controller import Controller
+from kukeon_tpu.runtime.daemon import RPCService, summarize_cell_scrape
+from kukeon_tpu.runtime.devices import TPUDeviceManager
+from kukeon_tpu.runtime.metadata import MetadataStore
+from kukeon_tpu.runtime.runner import Runner, RunnerOptions
+from kukeon_tpu.runtime.store import ResourceStore
+
+from test_obs import _parse_expo
+
+
+# --- federate text machinery -------------------------------------------------
+
+
+def _cell_registry(*, ready=1.0, uptime=100.0, ok_requests=50,
+                   queue=3, ttft=(0.01, 0.02, 0.04, 0.08)) -> Registry:
+    reg = Registry()
+    reg.gauge("kukeon_cell_ready", "ready").set(ready)
+    reg.gauge("kukeon_cell_uptime_seconds", "uptime").set(uptime)
+    reg.gauge("kukeon_cell_info", "info", labels=("model", "kind")).set(
+        1, model="tiny", kind="decoder")
+    c = reg.counter("kukeon_engine_requests_total", "req",
+                    labels=("outcome",))
+    c.inc(ok_requests, outcome="ok")
+    reg.gauge("kukeon_engine_queue_depth", "q").set(queue)
+    h = reg.histogram("kukeon_engine_ttft_seconds", "ttft")
+    for v in ttft:
+        h.observe(v)
+    return reg
+
+
+def test_federate_parse_inject_render_roundtrip():
+    reg = _cell_registry()
+    text = expo.render(reg)
+    fams = fed.parse(text)
+    assert fams["kukeon_engine_requests_total"].kind == "counter"
+    assert fams["kukeon_engine_ttft_seconds"].kind == "histogram"
+    fed.inject_label(fams, cell="r/s/st/c1")
+    out = fed.render(fams)
+    parsed = _parse_expo(out)            # strict golden parser accepts it
+    for _n, labels, _v in parsed["kukeon_engine_requests_total"]["samples"]:
+        assert labels["cell"] == "r/s/st/c1"
+    # Histogram child samples (_bucket/_sum/_count) are relabelled too.
+    bucket_rows = [s for s in parsed["kukeon_engine_ttft_seconds"]["samples"]
+                   if s[0].endswith("_bucket")]
+    assert bucket_rows and all(
+        lab["cell"] == "r/s/st/c1" for _n, lab, _v in bucket_rows)
+
+
+def test_federate_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        fed.parse("this is not prometheus text\n")
+    with pytest.raises(ValueError):
+        fed.parse("kukeon_orphan_total 3\n")   # sample before declaration
+
+
+def test_federate_histogram_counts_roundtrip():
+    reg = Registry()
+    h = reg.histogram("kukeon_t_fed_seconds", "x")
+    for v in (0.001, 0.001, 0.01, 5.0, 1e9):
+        h.observe(v)
+    fams = fed.parse(expo.render(reg))
+    bounds, counts = fed.histogram_counts(fams["kukeon_t_fed_seconds"])
+    assert bounds == h.buckets
+    assert counts == h.snapshot()[0]
+    p95 = obs.percentile_from_counts(bounds, counts, 0.95)
+    assert p95 == h.percentile(0.95)
+
+
+def test_merge_groups_families_across_cells():
+    a = fed.parse(expo.render(_cell_registry(queue=1)))
+    b = fed.parse(expo.render(_cell_registry(queue=9)))
+    fed.inject_label(a, cell="a")
+    fed.inject_label(b, cell="b")
+    merged = fed.merge([a, b])
+    text = fed.render(merged)
+    # One TYPE declaration per family, samples from both cells beneath it.
+    assert text.count("# TYPE kukeon_engine_queue_depth gauge") == 1
+    parsed = _parse_expo(text)
+    depths = {lab["cell"]: v for _n, lab, v
+              in parsed["kukeon_engine_queue_depth"]["samples"]}
+    assert depths == {"a": "1", "b": "9"}
+
+
+def test_summarize_cell_scrape_fields():
+    fams = fed.parse(expo.render(_cell_registry()))
+    row = summarize_cell_scrape(fams)
+    assert row["ready"] is True
+    assert row["model"] == "tiny"
+    assert row["qps"] == 0.5             # 50 requests / 100s uptime
+    assert row["queueDepth"] == 3
+    assert 0 < row["ttftP50S"] <= row["ttftP95S"] < 0.2
+
+
+# --- daemon federation over live endpoints -----------------------------------
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    registry: Registry = None  # type: ignore[assignment]
+
+    def log_message(self, fmt, *a):  # noqa: D102 — quiet test server
+        pass
+
+    def do_GET(self):
+        if self.path != "/metrics":
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = expo.render(self.registry).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", expo.CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _serve_registry(reg: Registry) -> tuple[ThreadingHTTPServer, int]:
+    handler = type("H", (_MetricsHandler,), {"registry": reg})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    """A controller (fake backend) running two reachable model cells backed
+    by real /metrics HTTP endpoints, plus one whose port is dead."""
+    store = ResourceStore(MetadataStore(str(tmp_path)))
+    runner = Runner(store, FakeBackend(), cgroups=None,
+                    devices=TPUDeviceManager(store.ms, chips=[0, 1, 2, 3]),
+                    options=RunnerOptions(stop_grace_s=0.2),
+                    registry=obs.Registry())
+    ctl = Controller(store, runner)
+    ctl.bootstrap()
+    servers = []
+    ports = {}
+    for name, queue in (("llm-a", 1), ("llm-b", 7)):
+        srv, port = _serve_registry(_cell_registry(queue=queue))
+        servers.append(srv)
+        ports[name] = port
+    ports["llm-dead"] = _free_port()
+    for name, port in ports.items():
+        doc = t.Document(
+            kind=t.KIND_CELL, metadata=t.Metadata(name=name),
+            spec=t.CellSpec(model=t.ModelSpec(model="tiny", chips=1,
+                                              port=port)),
+        )
+        ctl.create_cell(doc)
+    yield RPCService(ctl), ports
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_daemon_metrics_federates_cells(fleet):
+    """Acceptance: daemon metrics union >=2 running cells with cell=
+    labels; the unreachable cell is marked kukeon_cell_scrape_ok 0 and the
+    scrape still succeeds and golden-parses."""
+    service, _ports = fleet
+    out = service.Metrics()
+    fams = _parse_expo(out["text"])
+    # Daemon-side families survive, unlabelled.
+    assert "kukeon_daemon_uptime_seconds" in fams
+    # Cell families carry cell= labels for both reachable cells.
+    depths = {lab["cell"]: v for _n, lab, v
+              in fams["kukeon_engine_queue_depth"]["samples"]}
+    assert depths == {"default/default/default/llm-a": "1",
+                      "default/default/default/llm-b": "7"}
+    ok = {lab["cell"]: float(v) for _n, lab, v
+          in fams["kukeon_cell_scrape_ok"]["samples"]}
+    assert ok["default/default/default/llm-a"] == 1
+    assert ok["default/default/default/llm-b"] == 1
+    assert ok["default/default/default/llm-dead"] == 0
+    # Non-federated view still works (the old scrape shape).
+    bare = service.Metrics(federate=False)
+    assert "kukeon_cell_scrape_ok" not in bare["text"]
+
+
+def test_scrape_cells_summary_rows(fleet):
+    service, _ports = fleet
+    rows = {r["cell"]: r for r in service.ScrapeCells()["cells"]}
+    a = rows["default/default/default/llm-a"]
+    assert a["ok"] and a["ready"] and a["qps"] == 0.5 and a["queueDepth"] == 1
+    assert a["phase"] == "ready" and a["restarts"] == 0
+    dead = rows["default/default/default/llm-dead"]
+    assert dead["ok"] is False and "error" in dead
+
+
+@pytest.mark.slow
+def test_fleet_federation_e2e():
+    """Full-stack variant (excluded from tier-1 by the slow marker): a real
+    daemon supervises two real tiny model cells; `kuke daemon metrics`
+    federates both with cell= labels and `kuke top` renders the fleet."""
+    import json
+    import time
+    import urllib.request
+
+    from test_runtime_e2e import Daemon
+
+    d = Daemon(chips="0,1")
+    try:
+        manifest = """
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {name: fed-a}
+spec:
+  model: {model: tiny, chips: 1, port: 9481, numSlots: 2, maxSeqLen: 128,
+          hostNetwork: true}
+---
+apiVersion: kukeon.io/v1beta1
+kind: Cell
+metadata: {name: fed-b}
+spec:
+  model: {model: tiny, chips: 1, port: 9482, numSlots: 2, maxSeqLen: 128,
+          hostNetwork: true, sloTtftP95Ms: 500, sloAvailability: 0.999}
+"""
+        d.kuke("apply", "-f", "-", stdin_data=manifest)
+        deadline = time.monotonic() + 180
+        pending = {9481, 9482}
+        while pending and time.monotonic() < deadline:
+            for port in list(pending):
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/v1/health",
+                            timeout=1) as r:
+                        if json.loads(r.read())["status"] == "ok":
+                            pending.discard(port)
+                except OSError:
+                    pass
+            time.sleep(1.0)
+        assert not pending, f"model cells on ports {pending} never healthy"
+
+        metrics = d.kuke("daemon", "metrics").stdout
+        fams = _parse_expo(metrics)
+        cells = {lab["cell"]: float(v) for _n, lab, v
+                 in fams["kukeon_cell_scrape_ok"]["samples"]}
+        assert cells == {"default/default/default/fed-a": 1.0,
+                         "default/default/default/fed-b": 1.0}
+        labelled = {lab["cell"] for _n, lab, _v
+                    in fams["kukeon_engine_queue_depth"]["samples"]}
+        assert labelled == set(cells)
+        # The declared SLO objective federates through with the cell label.
+        objectives = {(lab["cell"], lab["slo"]): float(v) for _n, lab, v
+                      in fams["kukeon_slo_objective"]["samples"]}
+        assert objectives[("default/default/default/fed-b",
+                           "availability")] == 0.999
+
+        top = d.kuke("top").stdout
+        assert "default/default/default/fed-a" in top
+        assert "default/default/default/fed-b" in top
+        assert "P95TTFT" in top
+    finally:
+        d.stop()
+
+
+def test_kuke_top_renders_from_federated_scrape(fleet, capsys, monkeypatch):
+    import argparse
+
+    from kukeon_tpu.runtime import cli
+
+    service, _ports = fleet
+
+    class _Client:
+        def call(self, method, **params):
+            return getattr(service, method)(**params)
+
+    monkeypatch.setattr(cli, "_client", lambda args: _Client())
+    args = argparse.Namespace(json=False)
+    assert cli.cmd_top(args) == 0
+    out = capsys.readouterr().out
+    assert "CELL" in out and "P95TTFT" in out and "QUEUE" in out
+    assert "default/default/default/llm-a" in out
+    assert "down" in out                 # the dead cell row is visible
+    # JSON mode emits the raw rows.
+    args = argparse.Namespace(json=True)
+    assert cli.cmd_top(args) == 0
+    assert '"qps": 0.5' in capsys.readouterr().out

@@ -1,0 +1,412 @@
+"""Device & fleet observability (PR 4): HBM/compile telemetry, the
+on-demand profiler spool, SLO burn rates, trace request_id lookup, scrape
+hardening, percentile edge contracts, and the README metric-table guard."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import re
+import threading
+import time
+from http.server import ThreadingHTTPServer
+
+import jax
+import numpy as np
+import pytest
+
+from kukeon_tpu import faults
+from kukeon_tpu.models import llama
+from kukeon_tpu.obs import (
+    Registry,
+    SloObjectives,
+    SloTracker,
+    device_memory_collector,
+    percentile_from_counts,
+    render,
+)
+from kukeon_tpu.parallel import make_mesh
+from kukeon_tpu.serving import SamplingParams, ServingEngine
+
+from test_obs import _parse_expo
+
+PROMPT = np.arange(1, 9, dtype=np.int32)
+
+
+def _tiny_engine(**kw):
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+    kw.setdefault("num_slots", 2)
+    return ServingEngine(cfg, params, mesh, max_seq_len=96,
+                         decode_chunk=4, **kw)
+
+
+# --- device memory collector -------------------------------------------------
+
+
+def test_device_memory_families_always_declared():
+    """The kukeon_hbm_* families are part of the scrape schema on EVERY
+    backend; backends without memory stats (CPU) just contribute no
+    samples. TPU/GPU samples carry device= labels."""
+    reg = Registry()
+    reg.register_collector(device_memory_collector)
+    fams = _parse_expo(render(reg))
+    for name in ("kukeon_hbm_bytes_in_use", "kukeon_hbm_bytes_limit",
+                 "kukeon_hbm_bytes_peak"):
+        assert fams.get(name, {}).get("type") == "gauge", name
+        for _n, labels, _v in fams[name]["samples"]:
+            assert "device" in labels
+
+
+# --- compile tracking --------------------------------------------------------
+
+
+def test_decode_compile_counter_flat_across_slot_churn():
+    """Tier-1 acceptance: the engine docstring's 'occupancy changes never
+    recompile' promise, measured. After warmup, slot churn (requests of
+    different lengths entering and leaving the decode batch) must not move
+    kukeon_compiles_total{program="decode"}."""
+    eng = _tiny_engine()
+    eng.warmup(8)
+    base = eng.compiles.count("decode")
+    assert base >= 1                      # warmup really compiled something
+
+    # Churn: staggered submits so occupancy goes 1 -> 2 -> 1 -> 2 -> 0.
+    r1 = eng.submit(PROMPT, SamplingParams(max_new_tokens=12))
+    eng.step()
+    r2 = eng.submit(PROMPT[:4], SamplingParams(max_new_tokens=3))
+    while not r2.done.is_set():
+        eng.step()
+    r3 = eng.submit(PROMPT, SamplingParams(max_new_tokens=2))
+    while not (r1.done.is_set() and r3.done.is_set()):
+        eng.step()
+    assert eng.compiles.count("decode") == base, (
+        "decode recompiled during slot churn")
+
+    # The compile families land on the scrape with the right shapes.
+    fams = _parse_expo(render(eng.registry))
+    assert fams["kukeon_compiles_total"]["type"] == "counter"
+    assert fams["kukeon_compile_seconds"]["type"] == "histogram"
+    programs = {lab["program"] for _n, lab, _v
+                in fams["kukeon_compiles_total"]["samples"]}
+    assert {"prefill", "insert", "decode"} <= programs
+
+
+def test_compile_tracker_counts_new_shapes():
+    """A genuinely new shape (an unseen prefill bucket) IS counted — the
+    tracker distinguishes real compiles from steady state, not just
+    'nothing ever moves'."""
+    eng = _tiny_engine()
+    eng.generate(PROMPT, SamplingParams(max_new_tokens=2))
+    before = eng.compiles.count("prefill")
+    # 70 tokens pads to the 128 bucket: an unseen prefill shape.
+    eng.generate(np.ones((70,), np.int32), SamplingParams(max_new_tokens=2))
+    assert eng.compiles.count("prefill") > before
+
+
+# --- serving cell endpoints (acceptance) -------------------------------------
+
+
+@pytest.fixture(scope="module")
+def device_cell():
+    from kukeon_tpu.runtime.serving_cell import ServingCell, make_handler
+
+    cell = ServingCell("tiny", num_slots=2, max_seq_len=96, checkpoint=None,
+                       dtype=None, max_pending=8,
+                       slo_ttft_p95_ms=500.0, slo_availability=0.995)
+    cell.engine.start()
+    cell.mark_ready()
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(cell))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield cell, server.server_address[1]
+    server.shutdown()
+    server.server_close()
+    cell.engine.stop()
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    return resp.status, raw
+
+
+def _post(port, path, obj):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    body = json.dumps(obj).encode()
+    conn.request("POST", path, body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    return resp.status, json.loads(raw)
+
+
+def test_cell_metrics_expose_device_and_slo_families(device_cell):
+    """Acceptance: a cell scrape exposes the hbm, compile, and slo families
+    (golden-parsed), and the declared SLO objectives surface."""
+    cell, port = device_cell
+    cell.engine.generate(PROMPT, SamplingParams(max_new_tokens=3))
+    status, raw = _get(port, "/metrics")
+    assert status == 200
+    fams = _parse_expo(raw.decode())
+    for name, kind in (
+        ("kukeon_hbm_bytes_in_use", "gauge"),
+        ("kukeon_hbm_bytes_limit", "gauge"),
+        ("kukeon_hbm_bytes_peak", "gauge"),
+        ("kukeon_compiles_total", "counter"),
+        ("kukeon_compile_seconds", "histogram"),
+        ("kukeon_slo_objective", "gauge"),
+        ("kukeon_slo_burn_rate", "gauge"),
+        ("kukeon_slo_error_budget_remaining", "gauge"),
+        ("kukeon_profile_captures_total", "counter"),
+        ("kukeon_scrape_errors_total", "counter"),
+    ):
+        assert fams.get(name, {}).get("type") == kind, name
+    obj = {lab["slo"]: float(v) for _n, lab, v
+           in fams["kukeon_slo_objective"]["samples"]}
+    assert obj["availability"] == 0.995
+    assert abs(obj["ttft_p95"] - 0.5) < 1e-9
+    burn = {(lab["slo"], lab["window"]): float(v) for _n, lab, v
+            in fams["kukeon_slo_burn_rate"]["samples"]}
+    assert ("availability", "5m") in burn and ("ttft_p95", "1h") in burn
+
+
+def test_trace_request_id_exact_match(device_cell):
+    cell, port = device_cell
+    eng = cell.engine
+    req = eng.submit(PROMPT, SamplingParams(max_new_tokens=2))
+    assert req.done.wait(timeout=60)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        status, raw = _get(port, f"/v1/trace?request_id={req.id}")
+        assert status == 200
+        spans = json.loads(raw)["spans"]
+        if spans:
+            break
+        time.sleep(0.02)
+    assert spans and all(s["requestId"] == req.id for s in spans)
+    # Absent id -> empty list, not an error; bogus id -> 400.
+    status, raw = _get(port, "/v1/trace?request_id=999999")
+    assert status == 200 and json.loads(raw)["spans"] == []
+    status, _raw = _get(port, "/v1/trace?request_id=bogus")
+    assert status == 400
+
+
+def test_profile_capture_single_flight_and_spool(device_cell):
+    cell, port = device_cell
+    status, out = _post(port, "/v1/profile", {"durationMs": 400})
+    assert status == 200 and out["started"]
+    name = out["capture"]["name"]
+    # Single-flight: a second start while one runs answers 409.
+    status, out2 = _post(port, "/v1/profile", {"durationMs": 100})
+    assert status == 409
+    # The capture completes and lands in the spool listing.
+    deadline = time.monotonic() + 30
+    done = None
+    while time.monotonic() < deadline:
+        status, raw = _get(port, "/v1/profile")
+        assert status == 200
+        caps = json.loads(raw)["captures"]
+        done = next((c for c in caps
+                     if c["name"] == name and c["state"] == "done"), None)
+        if done:
+            break
+        time.sleep(0.05)
+    assert done is not None, "capture never completed"
+    assert done["sizeBytes"] > 0
+    assert os.path.isdir(done["path"])
+    # Bad durations are rejected, not silently clamped.
+    status, _ = _post(port, "/v1/profile", {"durationMs": -5})
+    assert status == 400
+
+
+@pytest.mark.faults
+def test_profile_capture_fault_path(device_cell):
+    """The profile.capture fault point fails the start cleanly (500 with
+    the injected error) and releases the single-flight latch."""
+    cell, port = device_cell
+    os.environ[faults.ENV] = "profile.capture:1:1"
+    status, out = _post(port, "/v1/profile", {"durationMs": 100})
+    assert status == 500 and "injected fault" in out["error"]
+    os.environ.pop(faults.ENV, None)
+    faults.reset()
+    # Latch released: the next capture starts fine.
+    status, out = _post(port, "/v1/profile", {"durationMs": 100})
+    assert status == 200
+    deadline = time.monotonic() + 30
+    while cell.profiler._active is not None:
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+
+
+def test_profile_spool_keeps_last_k(tmp_path):
+    from kukeon_tpu.obs import ProfileSpool
+
+    spool = ProfileSpool(base_dir=str(tmp_path / "spool"), keep=2)
+    for _ in range(4):
+        spool.start(30)
+        deadline = time.monotonic() + 30
+        while spool._active is not None:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+    done = [c for c in spool.list() if c["state"] == "done"]
+    assert len(done) <= 2
+    on_disk = [e for e in os.scandir(spool.base_dir) if e.is_dir()]
+    assert len(on_disk) <= 2
+
+
+# --- SLO tracker -------------------------------------------------------------
+
+
+def _slo_registry():
+    reg = Registry()
+    c = reg.counter("kukeon_engine_requests_total", "", labels=("outcome",))
+    h = reg.histogram("kukeon_engine_ttft_seconds", "")
+    return reg, c, h
+
+
+def test_slo_burn_rates_windowed():
+    clock = [0.0]
+    reg, c, h = _slo_registry()
+    tr = SloTracker(reg, SloObjectives(availability=0.99, ttft_p95_ms=100.0),
+                    clock=lambda: clock[0])
+
+    def collect():
+        return {f[0]: f for f in tr.collect()}
+
+    collect()                            # t=0 baseline snapshot (no traffic)
+
+    # Clean traffic: 100 ok requests, all well under the TTFT bound.
+    for _ in range(100):
+        c.inc(outcome="ok")
+        h.observe(0.01)
+    clock[0] = 10.0
+    fams = collect()
+    burns = {(lab["slo"], lab["window"]): v
+             for lab, v in fams["kukeon_slo_burn_rate"][3]}
+    assert burns[("availability", "5m")] == 0.0
+    assert burns[("ttft_p95", "1h")] == 0.0
+    remaining = {lab["slo"]: v
+                 for lab, v in fams["kukeon_slo_error_budget_remaining"][3]}
+    assert remaining["availability"] == 1.0
+
+    # 5 minutes later: a bad burst — 2 errors in 10 requests (20% bad
+    # against a 1% allowance => burn 20 in the 5m window), every TTFT slow.
+    clock[0] = 310.0
+    for _ in range(8):
+        c.inc(outcome="ok")
+        h.observe(1.0)                   # >> 100ms objective
+    for _ in range(2):
+        c.inc(outcome="error")
+    fams = collect()
+    burns = {(lab["slo"], lab["window"]): v
+             for lab, v in fams["kukeon_slo_burn_rate"][3]}
+    assert abs(burns[("availability", "5m")] - 20.0) < 1e-6
+    # The 1h window still includes the clean 100, diluting the burn.
+    assert 0 < burns[("availability", "1h")] < burns[("availability", "5m")]
+    assert burns[("ttft_p95", "5m")] > 1.0
+    remaining = {lab["slo"]: v
+                 for lab, v in fams["kukeon_slo_error_budget_remaining"][3]}
+    assert remaining["availability"] == 0.0   # clamped, budget blown
+
+
+def test_slo_no_traffic_is_clean():
+    reg, _c, _h = _slo_registry()
+    tr = SloTracker(reg, clock=lambda: 0.0)
+    fams = {f[0]: f for f in tr.collect()}
+    assert all(v == 0.0 for _l, v in fams["kukeon_slo_burn_rate"][3])
+    assert all(v == 1.0 for _l, v
+               in fams["kukeon_slo_error_budget_remaining"][3])
+
+
+# --- scrape hardening (satellite) --------------------------------------------
+
+
+def test_raising_gauge_callable_skips_sample_and_counts():
+    reg = Registry()
+    g = reg.gauge("kukeon_t_bad_gauge", "boom")
+    g.set_function(lambda: 1 / 0)
+    reg.gauge("kukeon_t_good_gauge", "fine").set(7)
+    text = render(reg)
+    fams = _parse_expo(text)             # exposition still parses
+    assert fams["kukeon_t_bad_gauge"]["samples"] == []
+    assert fams["kukeon_t_good_gauge"]["samples"][0][2] == "7"
+    errs = {lab["metric"]: float(v) for _n, lab, v
+            in fams["kukeon_scrape_errors_total"]["samples"]}
+    assert errs["kukeon_t_bad_gauge"] >= 1
+
+
+def test_raising_collector_skips_family_and_counts():
+    reg = Registry()
+    reg.gauge("kukeon_t_alive", "x").set(1)
+
+    def bad_collector():
+        raise RuntimeError("collector died")
+        yield  # pragma: no cover
+
+    reg.register_collector(bad_collector)
+    fams = _parse_expo(render(reg))
+    assert "kukeon_t_alive" in fams
+    errs = {lab["metric"] for _n, lab, _v
+            in fams["kukeon_scrape_errors_total"]["samples"]}
+    assert any("bad_collector" in m for m in errs)
+
+
+# --- percentile edge contracts (satellite) -----------------------------------
+
+
+def test_percentile_empty_returns_sentinel():
+    reg = Registry()
+    h = reg.histogram("kukeon_t_p_seconds", "p")
+    assert h.percentile(0.5) is None
+    assert h.percentile(0.0) is None
+    assert percentile_from_counts(h.buckets, [0] * (len(h.buckets) + 1),
+                                  0.99) is None
+
+
+def test_percentile_overflow_clamps_and_q_clamps():
+    reg = Registry()
+    h = reg.histogram("kukeon_t_q_seconds", "p")
+    h.observe(1e9)                        # far past the top bucket
+    assert h.percentile(0.5) == h.buckets[-1]
+    assert h.percentile(1.0) == h.buckets[-1]
+    # Out-of-range q clamps instead of fabricating ranks.
+    h2 = reg.histogram("kukeon_t_q2_seconds", "p")
+    for v in (0.001, 0.002, 0.004):
+        h2.observe(v)
+    assert h2.percentile(2.0) == h2.percentile(1.0)
+    assert h2.percentile(-1.0) == h2.percentile(0.0)
+
+
+# --- README metric-table guard (satellite) -----------------------------------
+
+
+def test_every_metric_family_is_documented_in_readme():
+    """Doc-drift guard (mirrors the PR-3 faults guard): every metric family
+    named in the package must appear in README's metric reference table.
+    Family names are exactly the lowercase kukeon_-prefixed string literals
+    in kukeon_tpu/ — verified against a few knowns so the scan can't decay
+    into vacuity."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        faults.__file__)))
+    names: set[str] = set()
+    for dirpath, _dirs, files in os.walk(os.path.join(pkg_root, "kukeon_tpu")):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fname)) as f:
+                names.update(re.findall(r'"(kukeon_[a-z0-9_]+)"', f.read()))
+    for must in ("kukeon_engine_ttft_seconds", "kukeon_compiles_total",
+                 "kukeon_hbm_bytes_in_use", "kukeon_slo_burn_rate",
+                 "kukeon_cell_scrape_ok", "kukeon_scrape_errors_total"):
+        assert must in names, f"scan failed to find {must}"
+    with open(os.path.join(pkg_root, "README.md")) as f:
+        readme = f.read()
+    missing = sorted(n for n in names if n not in readme)
+    assert not missing, (
+        f"metric families missing from the README reference table: {missing}")
