@@ -274,6 +274,109 @@ def phase_serve(args) -> None:
     }), flush=True)
 
 
+def phase_gateway(args) -> None:
+    """Scale-out serving through the replica gateway (`--replicas N`): N
+    in-process serving cells behind a GatewayCell, flooded by concurrent
+    prefix-id-carrying sessions. Measures aggregate tok/s THROUGH the proxy
+    plus the retry/shed work the routing layer absorbed. The replicas run
+    the tiny model on purpose — the layer under test is the gateway
+    (routing, affinity, passthrough), not the matmuls, so the number is
+    comparable on any backend."""
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    import numpy as np  # noqa: F401 — serving cell deps
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from kukeon_tpu.gateway.cell import GatewayCell, make_gateway_handler
+    from kukeon_tpu.runtime.serving_cell import ServingCell, make_handler
+
+    n = max(2, args.replicas)
+    backend = jax.default_backend()
+    _log(f"gateway: {n} tiny replicas [{backend}]")
+    cells, servers, urls = [], [], []
+    for _i in range(n):
+        cell = ServingCell("tiny", num_slots=4, max_seq_len=128,
+                           checkpoint=None, dtype=None, max_pending=256)
+        cell.engine.start()
+        cell.mark_ready()
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(cell))
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        cells.append(cell)
+        servers.append(srv)
+        urls.append(f"http://127.0.0.1:{srv.server_address[1]}")
+    gw = GatewayCell("tiny", urls, poll_interval_s=0.1)
+    gw.start()
+    gw_srv = ThreadingHTTPServer(("127.0.0.1", 0), make_gateway_handler(gw))
+    threading.Thread(target=gw_srv.serve_forever, daemon=True).start()
+    gw.router.poll_once()
+
+    sessions = 2 * n
+    per_session = 6
+    new_tokens = 16
+    tokens = [0]
+    statuses: dict[int, int] = {}
+    lock = threading.Lock()
+    t0 = time.monotonic()
+
+    def session(i: int) -> None:
+        import http.client
+
+        for _turn in range(per_session):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw_srv.server_address[1], timeout=120)
+            conn.request("POST", "/v1/generate", body=json.dumps({
+                "prompt": f"session {i} turn", "maxNewTokens": new_tokens,
+                "prefixId": f"sess-{i}"}),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            with lock:
+                statuses[resp.status] = statuses.get(resp.status, 0) + 1
+                if resp.status == 200:
+                    tokens[0] += json.loads(body).get("numTokens", 0)
+
+    threads = [threading.Thread(target=session, args=(i,))
+               for i in range(sessions)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=600)
+    dt = time.monotonic() - t0
+
+    total = sum(statuses.values())
+    retries = int(sum(v for _l, v in gw.registry.get(
+        "kukeon_gateway_retries_total").samples()))
+    result = {
+        "metric": f"gateway aggregate tok/s, {n} replicas, "
+                  f"{sessions} sessions, tiny [{backend}]",
+        "backend": backend,
+        "model": "tiny",
+        "model_id": "tiny",
+        "n_chips": len(jax.devices()),
+        "replicas": n,
+        "sessions": sessions,
+        "tok_per_s": round(tokens[0] / dt, 2),
+        "requests": total,
+        "retry_rate": round(retries / max(total, 1), 4),
+        "shed": int(gw.registry.get("kukeon_gateway_shed_total").value()),
+        "statuses": {str(k): v for k, v in sorted(statuses.items())},
+        "trials": [round(tokens[0] / dt, 1)],
+    }
+    gw_srv.shutdown()
+    gw.stop()
+    for srv in servers:
+        srv.shutdown()
+    for cell in cells:
+        cell.engine.stop()
+    if args.out:
+        write_artifact(args.out, result, result)
+    print(json.dumps(result), flush=True)
+
+
 def phase_embed(args) -> None:
     """Embedding-cell throughput (BASELINE config 5: bge-base embedding
     serving): sequences/s for batched ~128-token inputs."""
@@ -615,7 +718,11 @@ def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", default="all",
-                    choices=["all", "serve", "embed", "ab", "autotune"])
+                    choices=["all", "serve", "embed", "ab", "autotune",
+                             "gateway"])
+    # Scale-out routing benchmark: stand up a replica gateway + N in-process
+    # replicas and measure aggregate tok/s + retry rate through the proxy.
+    ap.add_argument("--replicas", type=int, default=1)
     # Sweep the serving perf levers and persist the winner to the tune
     # profile that ServingEngine/ServingCell read at boot (phase_autotune).
     ap.add_argument("--autotune", action="store_true")
@@ -630,14 +737,18 @@ def main() -> None:
     # Comma-separated prefill bucket ladder override (e.g. "256,1024,4096").
     ap.add_argument("--prefill-buckets", default=None)
     # Standardized trajectory artifact (e.g. --out BENCH_r06.json): one
-    # schema-versioned JSON file per run with percentiles, throughput,
-    # compile counts, and peak HBM, so BENCH_*.json points stay comparable
-    # across rounds regardless of how the console line evolves.
+    # schema-versioned JSON file per run (kukeon-bench/v2; read_artifact
+    # upgrades v1 points) with percentiles, throughput, compile counts,
+    # peak HBM, and the replica count, so BENCH_*.json points stay
+    # comparable across rounds regardless of how the console line evolves.
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
     if args.autotune or args.phase == "autotune":
         phase_autotune(args)
+        return
+    if args.phase == "gateway" or args.replicas > 1:
+        phase_gateway(args)
         return
     if args.phase == "serve":
         phase_serve(args)
@@ -785,15 +896,35 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def read_artifact(path: str) -> dict:
+    """Read a BENCH_rNN.json trajectory artifact, upgrading older schemas
+    in place: a kukeon-bench/v1 point (pre-gateway) is a single-engine
+    measurement, so it reads back as v2 with ``replicas: 1`` — trajectory
+    tooling compares one shape across rounds."""
+    with open(path) as f:
+        artifact = json.load(f)
+    schema = artifact.get("schema")
+    if schema == "kukeon-bench/v1":
+        artifact = dict(artifact)
+        artifact["schema"] = "kukeon-bench/v2"
+        artifact.setdefault("replicas", 1)
+    elif schema != "kukeon-bench/v2":
+        raise ValueError(f"unknown bench artifact schema {schema!r} in {path}")
+    return artifact
+
+
 def write_artifact(path: str, serve: dict, result: dict) -> None:
     """The standardized BENCH_rNN.json trajectory point: fixed schema, one
     file per run, every field from the product's own instruments."""
     artifact = {
-        "schema": "kukeon-bench/v1",
+        "schema": "kukeon-bench/v2",
         "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "backend": serve["backend"],
         "n_chips": serve["n_chips"],
         "model": serve.get("model_id") or serve["model"],
+        # v2: how many serving engines stood behind the measurement (the
+        # gateway phase sets >1; the classic serve phase is one engine).
+        "replicas": serve.get("replicas", 1),
         "sessions": serve["sessions"],
         "tok_per_s": round(serve["tok_per_s"], 2),
         "trials": serve["trials"],

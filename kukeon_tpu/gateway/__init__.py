@@ -1,0 +1,28 @@
+"""Replica gateway: scale-out serving for one model behind one endpoint.
+
+``ModelSpec.replicas: N`` makes the runner materialize N serving cells
+(ports ``port+1 .. port+N``) plus one gateway process on ``port``. The
+gateway proxies ``/v1/generate`` (ndjson streaming passthrough included),
+``/v1/embed``, and the health surface, routing by least queue depth (fed
+by cheap periodic ``/v1/stats`` polls) with prefix affinity: requests
+carrying a ``prefixId`` consistently hash to the same replica so that
+engine's prefix cache keeps hitting, falling back to least-loaded when the
+affine replica is unready or shedding.
+
+FlexNPU (arxiv 2606.04415) motivates the shape — co-located replicas
+behind a placement-aware front-end absorb bursty LLM traffic — and the
+profiled-segmentation line of work (arxiv 2503.01025) motivates routing on
+measured per-replica load instead of round-robin.
+
+Lifecycle: 429/503 from a replica triggers bounded retry on another
+replica (never mid-stream — those surface in-band), draining replicas
+leave rotation, and ``kuke rollout`` performs a drain → restart → ready
+rolling restart one replica at a time with zero failed requests.
+"""
+
+from kukeon_tpu.gateway.router import ReplicaState, Router  # noqa: F401
+from kukeon_tpu.gateway.rollout import (  # noqa: F401
+    RolloutError,
+    RolloutStep,
+    rolling_restart,
+)

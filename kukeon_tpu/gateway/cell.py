@@ -1,0 +1,402 @@
+"""Gateway cell: the HTTP front-end over N serving replicas.
+
+Entrypoint the runner materializes for a replicated ``ModelSpec``
+(``python -m kukeon_tpu.gateway.cell --port P --replica URL ...``). One
+process, no chips, stateless except for routing state — a crashed gateway
+restarts in milliseconds under the runner's restart policy while the
+replicas keep their engines warm.
+
+Routes:
+
+  GET  /healthz      -> liveness
+  GET  /readyz       -> 200 while >=1 replica is ready (503 otherwise)
+  GET  /v1/stats     -> gateway counters + per-replica routing snapshot
+  GET  /metrics      -> Prometheus exposition (kukeon_gateway_* families)
+  POST /v1/generate  -> proxied to a replica; ``"stream": true`` bodies are
+                        passed through byte-for-byte as ndjson
+  POST /v1/embed     -> proxied (no affinity; embeddings are stateless)
+
+Retry contract: a replica answering 429/503, or refusing the connection,
+triggers a bounded retry on another replica (each replica tried at most
+once per request). NEVER for mid-stream failures — by then bytes are on
+the client's wire, so the failure surfaces as the in-band terminal
+``{"error": ...}`` ndjson line the serving cell already speaks. When every
+replica failed, the last replica's 429/503 passes through (with its
+Retry-After); if nothing was reachable at all the gateway sheds 503.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import math
+import sys
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlsplit
+
+from kukeon_tpu.obs import Registry, expo
+from kukeon_tpu.gateway.router import Router
+
+# Retry-After the gateway itself sheds with (no replica routable). Short:
+# replicas blip for poll-interval-sized windows, not minutes.
+GATEWAY_RETRY_AFTER_S = 2.0
+STREAM_CHUNK = 65536
+
+
+class GatewayCell:
+    """Routing + proxy brain behind the HTTP handler (handler-free so tests
+    and bench.py can drive it in-process)."""
+
+    def __init__(self, model: str, replica_urls: list[str], *,
+                 registry: Registry | None = None,
+                 poll_interval_s: float = 0.5,
+                 poll_timeout_s: float = 1.0,
+                 request_timeout_s: float = 120.0):
+        self.model_name = model
+        self.request_timeout_s = request_timeout_s
+        self.router = Router(
+            [(f"r{i}", u) for i, u in enumerate(replica_urls)],
+            poll_interval_s=poll_interval_s, poll_timeout_s=poll_timeout_s)
+        self.started_at = time.time()
+
+        reg = registry if registry is not None else Registry()
+        self.registry = reg
+        reg.gauge("kukeon_gateway_info",
+                  "Static gateway identity (value always 1).",
+                  labels=("model",)).set(1, model=model)
+        reg.gauge("kukeon_gateway_uptime_seconds",
+                  "Seconds since gateway construction.").set_function(
+            lambda: time.time() - self.started_at)
+        reg.gauge("kukeon_gateway_replicas",
+                  "Replicas configured behind this gateway.").set(
+            len(replica_urls))
+        reg.gauge("kukeon_gateway_ready",
+                  "1 while at least one replica is ready.").set_function(
+            lambda: 1.0 if self.router.ready_count() else 0.0)
+        self._m_requests = reg.counter(
+            "kukeon_gateway_requests_total",
+            "Proxied requests by replica and outcome.",
+            labels=("replica", "outcome"))
+        self._m_retries = reg.counter(
+            "kukeon_gateway_retries_total",
+            "Retry-on-another-replica events by reason.",
+            labels=("reason",))
+        self._m_shed = reg.counter(
+            "kukeon_gateway_shed_total",
+            "Requests shed at the gateway (no routable replica).")
+        self._m_routing = reg.counter(
+            "kukeon_gateway_routing_total",
+            "Routing decisions by policy.", labels=("policy",))
+        ready_g = reg.gauge("kukeon_gateway_replica_ready",
+                            "1 while the replica is in rotation.",
+                            labels=("replica",))
+        depth_g = reg.gauge("kukeon_gateway_replica_queue_depth",
+                            "Last polled engine queue depth.",
+                            labels=("replica",))
+        for rep in self.router.replicas:
+            ready_g.set_function(
+                lambda r=rep: 1.0 if r.ready else 0.0, replica=rep.name)
+            depth_g.set_function(
+                lambda r=rep: float(r.queue_depth), replica=rep.name)
+
+    def start(self) -> None:
+        self.router.start()
+
+    def stop(self) -> None:
+        self.router.stop()
+
+    # --- proxy plumbing ----------------------------------------------------
+
+    def _open(self, rep, path: str, body: bytes):
+        """One upstream POST; returns (conn, resp). Caller owns closing."""
+        u = urlsplit(rep.url)
+        conn = http.client.HTTPConnection(u.hostname, u.port,
+                                          timeout=self.request_timeout_s)
+        try:
+            conn.request("POST", path, body=body,
+                         headers={"Content-Type": "application/json",
+                                  "Content-Length": str(len(body))})
+            return conn, conn.getresponse()
+        except Exception:
+            conn.close()
+            raise
+
+    def select_and_proxy(self, path: str, body: bytes,
+                         prefix_id: str | None):
+        """Route with bounded retry until a replica yields a non-retryable
+        response. Returns one of:
+
+          ("response", replica, conn, resp)  — pass this response through
+          ("shed", status, payload, retry_after_s) — gateway-level answer
+
+        A 2xx "response" may still be a stream the caller relays; the
+        replica's inflight counter was incremented via ``rep.begin()`` and
+        the caller must ``rep.end()`` when done with the response.
+        """
+        excluded: set[str] = set()
+        last: tuple | None = None   # (replica_name, status, body, retry_after)
+        repolled = False
+        attempts = 0
+        while attempts < max(1, len(self.router.replicas)):
+            rep, policy = self.router.pick(prefix_id, exclude=excluded)
+            if rep is None:
+                if not repolled:
+                    # The routable set can look empty for one poll interval
+                    # after a replica comes back (a rollout advances the
+                    # moment /readyz flips, faster than the poll tick).
+                    # Refresh the snapshot once before shedding — this is
+                    # the difference between a zero-failed-request rollout
+                    # and a sub-second 503 blip per replica.
+                    repolled = True
+                    self.router.poll_once()
+                    continue
+                break
+            attempts += 1
+            self._m_routing.inc(policy=policy)
+            rep.begin()
+            try:
+                conn, resp = self._open(rep, path, body)
+            except OSError as e:
+                rep.end()
+                self.router.mark_unready(rep)
+                self._m_requests.inc(replica=rep.name, outcome="connect_error")
+                self._m_retries.inc(reason="connect_error")
+                excluded.add(rep.name)
+                last = (rep.name, None, str(e), None)
+                continue
+            if resp.status in (429, 503):
+                payload = resp.read()
+                retry_after = resp.getheader("Retry-After")
+                conn.close()
+                rep.end()
+                if resp.status == 503:
+                    # Lifecycle refusal (draining / warming / wedged): out
+                    # of rotation until a poll says otherwise. 429 is queue
+                    # pressure — the replica stays routable for others.
+                    self.router.mark_unready(rep)
+                self._m_requests.inc(
+                    replica=rep.name,
+                    outcome="shed" if resp.status == 429 else "unready")
+                self._m_retries.inc(reason=f"status_{resp.status}")
+                excluded.add(rep.name)
+                last = (rep.name, resp.status, payload, retry_after)
+                continue
+            return ("response", rep, conn, resp)
+        # Every replica refused or nothing was routable.
+        if last is not None and last[1] in (429, 503):
+            self._m_shed.inc()
+            return ("shed", last[1], last[2], last[3])
+        self._m_shed.inc()
+        return ("shed", 503,
+                json.dumps({"error": "no replica available",
+                            "retryAfterSeconds": GATEWAY_RETRY_AFTER_S}
+                           ).encode(),
+                str(GATEWAY_RETRY_AFTER_S))
+
+    def stats(self) -> dict:
+        reg = self.registry
+        return {
+            "model": self.model_name,
+            "kind": "gateway",
+            "uptimeSeconds": round(time.time() - self.started_at, 1),
+            "replicas": [r.snapshot() for r in self.router.replicas],
+            "readyReplicas": self.router.ready_count(),
+            "requests": int(sum(
+                v for _l, v in reg.get(
+                    "kukeon_gateway_requests_total").samples())),
+            "retries": int(sum(
+                v for _l, v in reg.get(
+                    "kukeon_gateway_retries_total").samples())),
+            "shed": int(reg.get("kukeon_gateway_shed_total").value()),
+            # The gateway admits while >=1 replica does; surfacing the same
+            # ready/draining keys as a serving cell keeps pollers uniform.
+            "ready": self.router.ready_count() > 0,
+            "draining": False,
+            "queueDepth": sum(r.queue_depth for r in self.router.replicas),
+        }
+
+
+def make_gateway_handler(gw: GatewayCell):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *a):
+            sys.stderr.write("gateway: " + fmt % a + "\n")
+
+        def _send(self, code: int, obj: dict,
+                  headers: dict[str, str] | None = None):
+            body = json.dumps(obj).encode()
+            self._send_raw(code, body, "application/json", headers)
+
+        def _send_raw(self, code: int, body: bytes, content_type: str,
+                      headers: dict[str, str] | None = None):
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            path = urlsplit(self.path).path
+            if path in ("/healthz", "/v1/health"):
+                self._send(200, {"status": "ok", "model": gw.model_name,
+                                 "kind": "gateway"})
+            elif path == "/readyz":
+                n = gw.router.ready_count()
+                if n:
+                    self._send(200, {"ready": True, "readyReplicas": n})
+                else:
+                    self._send(503, {"ready": False,
+                                     "reason": "no replica ready"})
+            elif path == "/v1/stats":
+                self._send(200, gw.stats())
+            elif path == "/metrics":
+                self._send_raw(200, expo.render(gw.registry).encode(),
+                               expo.CONTENT_TYPE)
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            path = urlsplit(self.path).path
+            if path not in ("/v1/generate", "/v1/embed"):
+                self._send(404, {"error": f"no route {self.path}; this "
+                                          "gateway proxies /v1/generate "
+                                          "and /v1/embed"})
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            try:
+                req = json.loads(body or b"{}")
+                if not isinstance(req, dict):
+                    raise ValueError("request body must be a JSON object")
+            except ValueError as e:
+                self._send(400, {"error": f"invalid JSON body: {e}"})
+                return
+            prefix_id = None
+            stream = False
+            if path == "/v1/generate":
+                prefix_id = req.get("prefixId")
+                if prefix_id is not None and not isinstance(prefix_id, str):
+                    self._send(400, {"error": "prefixId must be a string"})
+                    return
+                stream = bool(req.get("stream"))
+
+            got = gw.select_and_proxy(path, body, prefix_id)
+            if got[0] == "shed":
+                _tag, status, payload, retry_after = got
+                secs = float(retry_after or GATEWAY_RETRY_AFTER_S)
+                self._send_raw(status, payload or b"{}", "application/json",
+                               {"Retry-After": str(max(1, math.ceil(secs)))})
+                return
+            _tag, rep, conn, resp = got
+            try:
+                if stream and resp.status == 200:
+                    self._relay_stream(rep, resp)
+                else:
+                    payload = resp.read()
+                    headers = {}
+                    ra = resp.getheader("Retry-After")
+                    if ra:
+                        headers["Retry-After"] = ra
+                    self._send_raw(
+                        resp.status, payload,
+                        resp.getheader("Content-Type") or "application/json",
+                        headers)
+                    gw._m_requests.inc(
+                        replica=rep.name,
+                        outcome="ok" if resp.status < 400 else
+                        f"status_{resp.status}")
+            except OSError:
+                pass   # client went away; nothing to tell it
+            finally:
+                conn.close()
+                rep.end()
+
+        def _relay_stream(self, rep, resp):
+            """Byte-for-byte ndjson passthrough. The replica frames the
+            stream by connection close (its handler speaks HTTP/1.0), so
+            copying raw body chunks until EOF reproduces the payload
+            exactly — UTF-8 split-codepoint holdback, in-band error lines
+            and all. A replica dying mid-stream surfaces as an in-band
+            terminal error line, never a retry (partial tokens are already
+            on the client's wire) and never a second status line."""
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             resp.getheader("Content-Type")
+                             or "application/x-ndjson")
+            self.end_headers()
+            trailing_newline = True
+            try:
+                while True:
+                    # read1, not read: read(n) blocks for n bytes or EOF,
+                    # which would buffer the whole close-framed stream and
+                    # destroy token-streaming latency; read1 relays each
+                    # token line the moment the replica flushes it.
+                    chunk = resp.read1(STREAM_CHUNK)
+                    if not chunk:
+                        break
+                    trailing_newline = chunk.endswith(b"\n")
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+                gw._m_requests.inc(replica=rep.name, outcome="ok")
+            except Exception as e:  # noqa: BLE001 — headers are out; stay in-band
+                gw._m_requests.inc(replica=rep.name, outcome="stream_error")
+                gw.router.mark_unready(rep)
+                try:
+                    line = json.dumps({"error": "replica failed mid-stream: "
+                                                f"{type(e).__name__}: {e}"})
+                    if not trailing_newline:
+                        # Keep the client's line parser intact: never glue
+                        # the terminal error onto a half-written record.
+                        self.wfile.write(b"\n")
+                    self.wfile.write((line + "\n").encode())
+                    self.wfile.flush()
+                except OSError:
+                    pass
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kukeon-gateway")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--replica", action="append", required=True,
+                    help="replica base URL (repeat per replica)")
+    ap.add_argument("--poll-interval-s", type=float, default=0.5)
+    ap.add_argument("--request-timeout-s", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    gw = GatewayCell(args.model, args.replica,
+                     poll_interval_s=args.poll_interval_s,
+                     request_timeout_s=args.request_timeout_s)
+    gw.start()
+    server = ThreadingHTTPServer((args.host, args.port),
+                                 make_gateway_handler(gw))
+
+    import signal as _signal
+    import threading as _threading
+
+    # The gateway is stateless: SIGTERM just stops the listener (off-thread
+    # — shutdown() blocks until serve_forever returns, and the signal
+    # handler runs on the serving thread). In-flight proxied requests ride
+    # their own handler threads to completion.
+    _signal.signal(_signal.SIGTERM, lambda *_a: _threading.Thread(
+        target=server.shutdown, daemon=True).start())
+
+    print(f"gateway: {args.model} routing {len(args.replica)} replicas "
+          f"on {args.host}:{args.port}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gw.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

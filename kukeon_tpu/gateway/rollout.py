@@ -1,0 +1,124 @@
+"""Rolling restart: drain -> wait drained -> restart -> wait ready, one
+replica at a time.
+
+The gateway makes the invariant cheap: a draining replica leaves rotation
+(its /v1/stats reports draining, and any straggler request it refuses with
+503 is retried on a sibling), so restarting replicas one by one — never
+proceeding until the previous one is back at /readyz 200 — keeps the
+replica set serving with zero failed requests throughout.
+
+The orchestration is transport-only here (HTTP drain/ready probes + a
+caller-supplied restart callable per replica) so the daemon RPC, the CLI,
+and the fake-backend tests all drive the exact same state machine; only
+the restart callable differs (real container restart vs fake backend).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Callable
+
+
+class RolloutError(RuntimeError):
+    """A replica failed to drain-exit or come back ready in time; the
+    rollout stops HERE (continuing would drain the next replica while this
+    one is down — exactly the capacity hole a rolling restart exists to
+    avoid)."""
+
+
+@dataclasses.dataclass
+class RolloutStep:
+    name: str                    # replica container name (for reporting)
+    url: str                     # replica base URL
+    restart: Callable[[], None]  # bring the drained replica back up
+
+
+def _post(url: str, timeout_s: float) -> None:
+    req = urllib.request.Request(url, data=b"{}", method="POST",
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s):
+        pass
+
+
+def _get_json(url: str, timeout_s: float) -> dict | None:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return json.loads(r.read())
+    except Exception:  # noqa: BLE001 — unreachable is a state, not an error
+        return None
+
+
+def wait_drained(url: str, timeout_s: float, *, poll_s: float = 0.1,
+                 http_timeout_s: float = 2.0) -> bool:
+    """True once the replica finished draining. A real serving cell shuts
+    its HTTP server down when the drain completes (then exits 0), so
+    *unreachable* is the authoritative drained signal; a cell still
+    answering reports drained when it stopped admitting and went idle."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        stats = _get_json(url + "/v1/stats", http_timeout_s)
+        if stats is None:
+            return True
+        if stats.get("draining") and not stats.get("inflight") \
+                and not stats.get("queueDepth"):
+            return True
+        time.sleep(poll_s)
+    return False
+
+
+def wait_ready(url: str, timeout_s: float, *, poll_s: float = 0.1,
+               http_timeout_s: float = 2.0) -> float | None:
+    """Seconds until /readyz answered 200, or None on timeout."""
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url + "/readyz",
+                                        timeout=http_timeout_s) as r:
+                if r.status == 200:
+                    return time.monotonic() - t0
+        except Exception:  # noqa: BLE001 — booting; keep polling
+            pass
+        time.sleep(poll_s)
+    return None
+
+
+def rolling_restart(steps: list[RolloutStep], *,
+                    drain_timeout_s: float = 60.0,
+                    ready_timeout_s: float = 300.0,
+                    poll_s: float = 0.1,
+                    http_timeout_s: float = 2.0,
+                    on_event: Callable[[str, str], None] | None = None
+                    ) -> list[dict]:
+    """Run the drain → wait → restart → wait-ready cycle over every step in
+    order. Returns one record per replica; raises RolloutError the moment a
+    replica cannot be brought back ready."""
+    ev = on_event or (lambda _replica, _what: None)
+    results: list[dict] = []
+    for step in steps:
+        ev(step.name, "drain")
+        try:
+            _post(step.url + "/drain", http_timeout_s)
+        except (urllib.error.URLError, OSError):
+            # Already down (crashed replica): the restart still runs — a
+            # rollout doubles as recovery for a dead replica.
+            pass
+        drained = wait_drained(step.url, drain_timeout_s, poll_s=poll_s,
+                               http_timeout_s=http_timeout_s)
+        ev(step.name, "restart")
+        step.restart()
+        ready_s = wait_ready(step.url, ready_timeout_s, poll_s=poll_s,
+                             http_timeout_s=http_timeout_s)
+        if ready_s is None:
+            raise RolloutError(
+                f"replica {step.name} did not become ready within "
+                f"{ready_timeout_s:.0f}s after restart; rollout stopped "
+                f"({len(results)} of {len(steps)} replicas done)")
+        ev(step.name, "ready")
+        results.append({"replica": step.name, "drained": drained,
+                        "readyS": round(ready_s, 3)})
+    return results

@@ -1,0 +1,161 @@
+"""Routing policy for the replica gateway.
+
+Two inputs, one decision:
+
+- **Measured load.** A background thread polls every replica's
+  ``GET /v1/stats`` (cheap JSON the cell already serves) and keeps
+  per-replica readiness, drain state, and queue depth. Routing reads the
+  cached snapshot — the hot path never blocks on a poll.
+- **Prefix affinity.** Requests carrying a ``prefixId`` rendezvous-hash to
+  one replica (highest ``sha256(prefix_id | replica)`` wins), so an agent
+  session's growing context keeps hitting the SAME engine's prefix cache.
+  Rendezvous hashing keeps the mapping stable when a replica drops out:
+  only the keys that hashed to the lost replica move.
+
+Default policy is least queue depth (gateway-side in-flight counts break
+ties) over the ready set; the affine replica wins when it is ready and not
+excluded by an earlier failed attempt this request.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import urllib.request
+
+
+class ReplicaState:
+    """One replica's routing view: identity + the last polled snapshot."""
+
+    def __init__(self, name: str, url: str):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.ready = False
+        self.draining = False
+        self.queue_depth = 0
+        self.poll_ok = False
+        self.last_poll_at = 0.0
+        # Gateway-side in-flight proxied requests: fresher than the polled
+        # queue depth, used as the tiebreaker between equally-deep queues.
+        self.inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    def begin(self):
+        with self._inflight_lock:
+            self.inflight += 1
+
+    def end(self):
+        with self._inflight_lock:
+            self.inflight -= 1
+
+    def load(self) -> int:
+        return self.queue_depth + self.inflight
+
+    def snapshot(self) -> dict:
+        return {
+            "name": self.name,
+            "url": self.url,
+            "ready": self.ready,
+            "draining": self.draining,
+            "queueDepth": self.queue_depth,
+            "inflight": self.inflight,
+            "pollOk": self.poll_ok,
+        }
+
+
+POLICY_AFFINITY = "affinity"
+POLICY_AFFINITY_FALLBACK = "affinity_fallback"
+POLICY_LEAST_LOADED = "least_loaded"
+
+
+class Router:
+    """Replica table + poll loop + pick().
+
+    Thread-safe by construction: poll writes plain attributes the pick path
+    reads (worst case a pick routes on a snapshot one poll stale, which the
+    retry layer above absorbs).
+    """
+
+    def __init__(self, replicas: list[tuple[str, str]], *,
+                 poll_interval_s: float = 0.5, poll_timeout_s: float = 1.0):
+        self.replicas = [ReplicaState(n, u) for n, u in replicas]
+        self.by_name = {r.name: r for r in self.replicas}
+        self.poll_interval_s = poll_interval_s
+        self.poll_timeout_s = poll_timeout_s
+        self._halt = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # --- polling -----------------------------------------------------------
+
+    def poll_once(self) -> None:
+        for rep in self.replicas:
+            try:
+                with urllib.request.urlopen(rep.url + "/v1/stats",
+                                            timeout=self.poll_timeout_s) as r:
+                    stats = json.loads(r.read())
+                rep.draining = bool(stats.get("draining"))
+                rep.queue_depth = int(stats.get("queueDepth") or 0)
+                rep.ready = bool(stats.get("ready", True)) and not rep.draining
+                rep.poll_ok = True
+            except Exception:  # noqa: BLE001 — an unreachable replica is routing data
+                rep.poll_ok = False
+                rep.ready = False
+            rep.last_poll_at = time.monotonic()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._halt.clear()
+        self._thread = threading.Thread(target=self._poll_loop, daemon=True,
+                                        name="gateway-poll")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._halt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _poll_loop(self) -> None:
+        # First poll immediately so the gateway routes as soon as it binds.
+        self.poll_once()
+        while not self._halt.wait(self.poll_interval_s):
+            self.poll_once()
+
+    # --- instantaneous demotion -------------------------------------------
+
+    def mark_unready(self, rep: ReplicaState) -> None:
+        """Demote NOW on a 503 / connection failure observed while proxying
+        (the poll would take up to an interval to notice); the next
+        successful poll promotes it back."""
+        rep.ready = False
+
+    # --- selection ---------------------------------------------------------
+
+    def affine(self, prefix_id: str) -> ReplicaState:
+        """Rendezvous hash over the FULL replica set (not just the ready
+        ones): the mapping must not churn when a replica blips unready, or
+        every blip would scatter warm prefixes across the fleet."""
+        return max(self.replicas, key=lambda r: hashlib.sha256(
+            f"{prefix_id}|{r.name}".encode()).digest())
+
+    def pick(self, prefix_id: str | None = None,
+             exclude: frozenset | set = frozenset()
+             ) -> tuple[ReplicaState | None, str | None]:
+        """(replica, policy) — or (None, None) when nothing is routable."""
+        policy = POLICY_LEAST_LOADED
+        if prefix_id is not None:
+            a = self.affine(prefix_id)
+            if a.ready and a.name not in exclude:
+                return a, POLICY_AFFINITY
+            policy = POLICY_AFFINITY_FALLBACK
+        ready = [r for r in self.replicas
+                 if r.ready and r.name not in exclude]
+        if not ready:
+            return None, None
+        return min(ready, key=lambda r: (r.load(), r.name)), policy
+
+    def ready_count(self) -> int:
+        return sum(1 for r in self.replicas if r.ready)

@@ -164,6 +164,12 @@ class ModelSpec:
     model: str = ""                  # e.g. "llama3-8b", "llama3-1b", "tiny"
     chips: int = 1
     port: int = 9000
+    # Scale-out: N > 1 materializes N serving containers (each granted
+    # ``chips`` chips, listening on port+1 .. port+N) plus one gateway
+    # container on ``port`` that routes by least queue depth with
+    # prefix-id affinity (kukeon_tpu/gateway). The client-facing endpoint
+    # is ``port`` either way; replicas=1 keeps the single-engine shape.
+    replicas: int = 1
     num_slots: int = 8
     max_seq_len: int | None = None
     checkpoint: str | None = None    # orbax checkpoint dir; random-init if None

@@ -11,7 +11,7 @@ import yaml
 from kukeon_tpu.runtime import naming
 from kukeon_tpu.runtime.api import types as t
 from kukeon_tpu.runtime.api.wire import from_wire
-from kukeon_tpu.runtime.apply.validate import validate_spec
+from kukeon_tpu.runtime.apply.validate import validate_manifest, validate_spec
 from kukeon_tpu.runtime.errors import InvalidArgument
 
 # Scope requirements per kind: which metadata fields must / may be set.
@@ -33,6 +33,9 @@ def parse_documents(blob: str, source: str = "<manifest>") -> list[t.Document]:
         docs.append(parse_document(raw, f"{source}[{i}]"))
     if not docs:
         raise InvalidArgument(f"{source}: no documents found")
+    # Cross-document depth (per-doc validation already ran): model-cell
+    # port ranges within one manifest must be disjoint.
+    validate_manifest(docs)
     return docs
 
 

@@ -238,10 +238,23 @@ def validate_cell(spec: t.CellSpec, ctx: str, *, in_blueprint: bool = False) -> 
             raise InvalidArgument(f"{ctx}: model.chips must be >= 1")
         if not (1 <= m.port <= 65535):
             raise InvalidArgument(f"{ctx}: model.port {m.port} out of range")
-        if (m.port, "tcp") in host_ports:
+        if m.replicas < 1:
             raise InvalidArgument(
-                f"{ctx}: model.port {m.port} collides with a container port"
+                f"{ctx}: model.replicas must be >= 1, got {m.replicas}"
             )
+        ports = model_ports(m)
+        if ports[-1] > 65535:
+            raise InvalidArgument(
+                f"{ctx}: model.replicas={m.replicas} needs ports "
+                f"{ports[0]}..{ports[-1]} (gateway on {m.port}, replicas "
+                f"above it), past 65535"
+            )
+        for p in ports:
+            if (p, "tcp") in host_ports:
+                raise InvalidArgument(
+                    f"{ctx}: model port {p} (of replica range "
+                    f"{ports[0]}..{ports[-1]}) collides with a container port"
+                )
         if m.num_slots < 1:
             raise InvalidArgument(f"{ctx}: model.numSlots must be >= 1")
         if m.max_seq_len is not None and m.max_seq_len < 16:
@@ -259,6 +272,41 @@ def validate_cell(spec: t.CellSpec, ctx: str, *, in_blueprint: bool = False) -> 
             raise InvalidArgument(
                 f"{ctx}: model.sloAvailability must be a fraction in (0, 1)"
             )
+
+
+def model_ports(m: t.ModelSpec) -> list[int]:
+    """Every TCP port a ModelSpec's cell claims: just ``port`` for a single
+    engine; the gateway on ``port`` plus replicas on ``port+1..port+N``
+    when replicated (the runner's base-port scheme)."""
+    n = m.replicas or 1
+    if n <= 1:
+        return [m.port]
+    return list(range(m.port, m.port + n + 1))
+
+
+def validate_manifest(docs: list[t.Document]) -> None:
+    """Cross-document depth pass over ONE manifest: two ModelSpecs whose
+    replica port ranges overlap would race for the same listen sockets at
+    runtime (EADDRINUSE inside a cell, long after apply said ok) — die here
+    instead, naming both specs."""
+    seen: list[tuple[str, list[int]]] = []
+    for d in docs:
+        if d.kind != t.KIND_CELL or getattr(d.spec, "model", None) is None:
+            continue
+        m = d.spec.model
+        ports = model_ports(m)
+        ctx = f"Cell/{d.metadata.name}"
+        for other_ctx, other_ports in seen:
+            overlap = sorted(set(ports) & set(other_ports))
+            if overlap:
+                raise InvalidArgument(
+                    f"{ctx}: model port range {ports[0]}..{ports[-1]} "
+                    f"collides with {other_ctx} (range "
+                    f"{other_ports[0]}..{other_ports[-1]}) on port(s) "
+                    f"{overlap}; replicated models claim "
+                    "port..port+replicas — give each spec a disjoint range"
+                )
+        seen.append((ctx, ports))
 
 
 def validate_space(spec: t.SpaceSpec, ctx: str) -> None:

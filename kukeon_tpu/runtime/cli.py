@@ -3,7 +3,8 @@
 Verbs: init, daemon (serve/start/stop/kill/restart/status/logs/metrics),
 apply,
 create, delete, get, run, start, stop, kill, attach, log, purge, refresh,
-status, top, doctor, image, build, team, uninstall, version, autocomplete.
+rollout, status, top, doctor, image, build, team, uninstall, version,
+autocomplete.
 
 Workload verbs route to the daemon; read/maintenance verbs "promote" to an
 in-process controller when --no-daemon / KUKEON_NO_DAEMON is set (reference
@@ -678,6 +679,17 @@ def cmd_top(args):
                              "-", r.get("restarts", 0))
                   + f"  ({r.get('error', 'scrape failed')})")
             continue
+        if r.get("kind") == "gateway":
+            # Gateway row: the replicated cell's front door. READY is the
+            # replica census, QPS the aggregate over replicas; latency/HBM
+            # live on the per-replica rows beneath it.
+            ready = (f"{r.get('readyReplicas', 0)}/{r.get('replicas', '?')}")
+            print(fmt.format(
+                r["cell"], r.get("model") or "-", ready,
+                f"{r['qps']:.1f}" if r.get("qps") is not None else "-",
+                "-", "-", "-", "-", r.get("restarts", 0))
+                + f"  (gateway, retries={r.get('retries', 0)})")
+            continue
         hbm = "-"
         if r.get("hbmInUseBytes") is not None:
             hbm = (f"{_fmt_bytes(r['hbmInUseBytes'])}"
@@ -688,6 +700,26 @@ def cmd_top(args):
             f"{r['qps']:.1f}" if r.get("qps") is not None else "-",
             _fmt_ms(r.get("ttftP50S")), _fmt_ms(r.get("ttftP95S")),
             r.get("queueDepth", "-"), hbm, r.get("restarts", 0)))
+    return 0
+
+
+def cmd_rollout(args):
+    """Rolling restart of a replicated model cell (drain -> restart ->
+    ready, one replica at a time; the daemon drives it, the gateway keeps
+    traffic flowing). Zero failed requests is the contract."""
+    c = _client(args)
+    s = _scope(args)
+    out = c.call("RolloutCell", **s, name=args.name,
+                 drainTimeoutS=args.drain_timeout,
+                 readyTimeoutS=args.ready_timeout)
+    if args.json:
+        _print(out, True)
+        return 0
+    for r in out["replicas"]:
+        drained = "drained" if r["drained"] else "drain timeout (restarted anyway)"
+        print(f"  {r['replica']}: {drained}, ready again in {r['readyS']}s")
+    print(f"cell/{args.name}: rollout complete "
+          f"({len(out['replicas'])} replicas)")
     return 0
 
 
@@ -793,12 +825,12 @@ _BASH_COMPLETION = """\
 _kuke_complete() {
     local cur="${COMP_WORDS[COMP_CWORD]}" prev="${COMP_WORDS[COMP_CWORD-1]}"
     local verbs="init apply create build daemon get delete doctor start status \
-stop team kill purge refresh run attach log top autocomplete image uninstall version"
+stop team kill purge refresh rollout run attach log top autocomplete image uninstall version"
     if [ "$COMP_CWORD" -eq 1 ]; then
         COMPREPLY=($(compgen -W "$verbs" -- "$cur")); return
     fi
     case "$prev" in
-        start|stop|kill|attach|log|run)
+        start|stop|kill|attach|log|run|rollout)
             COMPREPLY=($(compgen -W "$(kuke autocomplete cells 2>/dev/null)" -- "$cur"));;
         get|delete|purge|create)
             COMPREPLY=($(compgen -W "realm space stack cell secret blueprint \
@@ -958,6 +990,14 @@ def build_parser() -> argparse.ArgumentParser:
     sub_add("doctor")
     sub_add("refresh")
 
+    sp = sub_add("rollout")
+    sp.add_argument("name")
+    sp.add_argument("--drain-timeout", type=float, default=60.0,
+                    help="seconds to wait for each replica's drain")
+    sp.add_argument("--ready-timeout", type=float, default=300.0,
+                    help="seconds to wait for each restarted replica's readyz")
+    _scope_args(sp)
+
     sp = sub_add("image")
     sp.add_argument("image_cmd",
                     choices=["list", "get", "delete", "prune", "load", "save",
@@ -1024,6 +1064,7 @@ HANDLERS = {
     "log": cmd_log,
     "status": cmd_status,
     "top": cmd_top,
+    "rollout": cmd_rollout,
     "doctor": cmd_doctor,
     "refresh": cmd_refresh,
     "purge": cmd_purge,

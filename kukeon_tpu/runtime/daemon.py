@@ -53,7 +53,9 @@ def model_cell_endpoints(ctl) -> list[tuple[str, str, dict]]:
 
     The endpoint is the cell's bridge IP when the space network attached
     one, else the host loopback (hostNetwork cells and the process backend
-    both bind there)."""
+    both bind there). A replicated cell contributes its gateway (under the
+    cell's own key, on the base port) AND every replica (``key/rI`` on
+    ``port+1+i``) so a federated scrape sees the whole replica set."""
     out: list[tuple[str, str, dict]] = []
     for realm in ctl.list_realms():
         for rec in ctl.list_cells(realm):
@@ -66,7 +68,13 @@ def model_cell_endpoints(ctl) -> list[tuple[str, str, dict]]:
             host = st.get("ip") or "127.0.0.1"
             key = "/".join((rec["realm"], rec["space"], rec["stack"],
                             rec["name"]))
-            out.append((key, f"http://{host}:{m.get('port', 9000)}", rec))
+            port = m.get("port", 9000)
+            out.append((key, f"http://{host}:{port}", rec))
+            replicas = m.get("replicas") or 1
+            if replicas > 1:
+                for i in range(replicas):
+                    out.append((f"{key}/r{i}",
+                                f"http://{host}:{port + 1 + i}", rec))
     return out
 
 
@@ -169,6 +177,52 @@ def summarize_cell_scrape(fams: dict) -> dict:
     if burn is not None:
         out["sloBurn1h"] = round(burn, 4)
     return out
+
+
+def summarize_gateway_scrape(fams: dict) -> dict:
+    """A gateway scrape's `kuke top` row: aggregate QPS over its replicas,
+    retry count, and the per-replica ready census (the gateway's own
+    routing view — the same gauges it routes on)."""
+    def family_total(name: str) -> float | None:
+        """Sum over a family's samples; a DECLARED labelled counter with no
+        label sets yet is an honest zero, not an absence."""
+        fam = fams.get(name)
+        if fam is None:
+            return None
+        return sum(float(v) for _n, _l, v in fam.samples)
+
+    out: dict = {"kind": "gateway"}
+    info = fams.get("kukeon_gateway_info")
+    if info is not None and info.samples:
+        out["model"] = info.samples[0][1].get("model")
+    uptime = _sample_value(fams, "kukeon_gateway_uptime_seconds")
+    total = family_total("kukeon_gateway_requests_total")
+    if uptime and total is not None:
+        out["qps"] = round(total / max(uptime, 1e-9), 3)
+    retries = family_total("kukeon_gateway_retries_total")
+    if retries is not None:
+        out["retries"] = int(retries)
+    shed = _sample_value(fams, "kukeon_gateway_shed_total")
+    if shed is not None:
+        out["shed"] = int(shed)
+    ready_f = fams.get("kukeon_gateway_replica_ready")
+    if ready_f is not None and ready_f.samples:
+        vals = [float(v) for _n, _l, v in ready_f.samples]
+        out["readyReplicas"] = int(sum(vals))
+        out["replicas"] = len(vals)
+    n = _sample_value(fams, "kukeon_gateway_replicas")
+    if n is not None:
+        out["replicas"] = int(n)
+    out["ready"] = bool(out.get("readyReplicas"))
+    return out
+
+
+def _rollout_restart(ctl, rec, container_name: str) -> None:
+    """The RolloutCell restart seam: bring one drained replica container
+    back up on its own chip grant (module-level so tests can wrap it to
+    also respawn their fake replica servers)."""
+    ctl.runner.restart_container(rec.realm, rec.space, rec.stack, rec.name,
+                                 container_name)
 
 
 def build_controller(run_path: str,
@@ -504,11 +558,61 @@ class RPCService:
                        c.get("restarts", 0) for c in
                        (rec.get("status") or {}).get("containers", []))}
             if s["ok"]:
-                row.update(summarize_cell_scrape(s["families"]))
+                fams = s["families"]
+                # A replicated cell's base endpoint is its gateway; its
+                # replicas ride along as key/rI rows with the normal
+                # engine summary.
+                if "kukeon_gateway_info" in fams:
+                    row.update(summarize_gateway_scrape(fams))
+                else:
+                    row.update(summarize_cell_scrape(fams))
             else:
                 row["error"] = s["error"]
             rows.append(row)
         return {"cells": rows}
+
+    def RolloutCell(self, realm: str, space: str, stack: str, name: str,
+                    drainTimeoutS: float = 60.0,
+                    readyTimeoutS: float = 300.0) -> dict:
+        """Rolling restart of a replicated model cell with zero failed
+        requests: one replica at a time, drain -> wait drained (a drained
+        serving cell exits its HTTP server, so unreachable = drained) ->
+        restart on the same chip grant -> wait /readyz 200. The gateway
+        keeps the cell serving throughout — draining replicas leave its
+        rotation and stragglers retry onto siblings."""
+        from kukeon_tpu.gateway import rollout as ro
+
+        rec = self.ctl.store.read_cell(realm or consts.DEFAULT_REALM,
+                                       space or consts.DEFAULT_SPACE,
+                                       stack or consts.DEFAULT_STACK, name)
+        m = rec.spec.model
+        if m is None:
+            raise FailedPrecondition(f"cell {name!r} is not a model cell")
+        if (m.replicas or 1) <= 1:
+            raise FailedPrecondition(
+                f"cell {name!r} has replicas=1; a rolling restart needs a "
+                "replicated model cell (set model.replicas >= 2)"
+            )
+        host = rec.status.ip or "127.0.0.1"
+        steps = []
+        for i in range(m.replicas):
+            cname = f"model-server-{i}"
+            url = f"http://{host}:{m.port + 1 + i}"
+
+            def restart(cname=cname):
+                _rollout_restart(self.ctl, rec, cname)
+
+            steps.append(ro.RolloutStep(name=cname, url=url, restart=restart))
+        try:
+            results = ro.rolling_restart(
+                steps, drain_timeout_s=drainTimeoutS,
+                ready_timeout_s=readyTimeoutS)
+        except ro.RolloutError as e:
+            # Typed so the CLI prints the stall cleanly instead of an
+            # "internal" traceback code.
+            raise FailedPrecondition(str(e)) from None
+        return {"cell": "/".join((rec.realm, rec.space, rec.stack, rec.name)),
+                "replicas": results}
 
     def Status(self) -> dict:
         ms = self.ctl.store.ms

@@ -246,17 +246,58 @@ class Runner:
             return rec
 
     def cell_containers(self, rec: model.CellRecord) -> list[t.ContainerSpec]:
-        """Declared containers plus the materialized serving container for
-        model cells."""
+        """Declared containers plus the materialized serving container(s)
+        for model cells (N replicas + a gateway when ``replicas > 1``)."""
         containers = list(rec.spec.containers)
         if rec.spec.model is not None:
-            containers.append(self._model_container(rec.spec.model))
+            containers.extend(self._model_containers(rec.spec.model))
         return containers
 
-    def _model_container(self, m: t.ModelSpec) -> t.ContainerSpec:
+    def _model_containers(self, m: t.ModelSpec) -> list[t.ContainerSpec]:
+        """The base-port scheme: a single engine keeps today's shape (one
+        ``model-server`` on ``m.port``); ``replicas: N`` materializes
+        ``model-server-0..N-1`` on ``port+1..port+N`` (each with its own
+        ``chips`` grant — declaration order partitions the cell's chips
+        deterministically, so a restarted replica gets ITS chips back) plus
+        one chip-less ``gateway`` container on ``m.port`` so the
+        client-facing endpoint never moves."""
+        n = m.replicas or 1
+        if n <= 1:
+            return [self._model_container(m)]
+        out = [
+            self._model_container(m, name=f"model-server-{i}",
+                                  port=m.port + 1 + i)
+            for i in range(n)
+        ]
+        out.append(self._gateway_container(m))
+        return out
+
+    def _gateway_container(self, m: t.ModelSpec) -> t.ContainerSpec:
+        cmd = [
+            self.opts.serving_python, "-m", "kukeon_tpu.gateway.cell",
+            "--model", m.model, "--port", str(m.port),
+        ]
+        if not m.host_network and self.backend.isolated:
+            cmd += ["--host", "0.0.0.0"]
+        # Replicas share the cell's netns (or the host loopback on the
+        # process backend), so the gateway always reaches them on 127.0.0.1.
+        for i in range(m.replicas):
+            cmd += ["--replica", f"http://127.0.0.1:{m.port + 1 + i}"]
+        return t.ContainerSpec(
+            name="gateway",
+            command=cmd,
+            restart_policy=t.RestartPolicy(policy="always",
+                                           backoff_seconds=1.0),
+            ports=[t.PortSpec(port=m.port, name="http")],
+            host_network=m.host_network,
+        )
+
+    def _model_container(self, m: t.ModelSpec, *, name: str = "model-server",
+                         port: int | None = None) -> t.ContainerSpec:
+        port = m.port if port is None else port
         cmd = [
             self.opts.serving_python, "-m", "kukeon_tpu.runtime.serving_cell",
-            "--model", m.model, "--port", str(m.port),
+            "--model", m.model, "--port", str(port),
             "--num-slots", str(m.num_slots),
         ]
         if not m.host_network and self.backend.isolated:
@@ -285,11 +326,11 @@ class Runner:
         if m.slo_availability:
             cmd += ["--slo-availability", str(m.slo_availability)]
         return t.ContainerSpec(
-            name="model-server",
+            name=name,
             command=cmd,
             resources=t.Resources(tpu_chips=m.chips),
             restart_policy=t.RestartPolicy(policy="always", backoff_seconds=2.0),
-            ports=[t.PortSpec(port=m.port, name="http")],
+            ports=[t.PortSpec(port=port, name="http")],
             # Spec-visible decision (ModelSpec.host_network): default is the
             # space network + egress policy; true exempts the cell for hosts
             # whose TPU runtime plane requires the host net.
@@ -703,6 +744,61 @@ class Runner:
                 self.backend.signal_container(ctx, _signal.SIGKILL)
         self._finish_stop(rec, contexts)
         return rec
+
+    def restart_container(self, realm: str, space: str, stack: str,
+                          name: str, container: str) -> model.CellRecord:
+        """Immediate single-container restart on the SAME chip grant — the
+        rolling-restart primitive (`kuke rollout`). Unlike the reconcile
+        path this honors no backoff: the caller already drained the replica
+        and is gating on /readyz, so waiting out a crash-loop damper would
+        only stretch the capacity hole. A container still running (drain
+        wedged short of exit) gets the stop grace window, then SIGKILL —
+        the drain already emptied it."""
+        import signal as _signal
+
+        with self.cell_lock(realm, space, stack, name):
+            rec = self.store.read_cell(realm, space, stack, name)
+            containers = self.cell_containers(rec)
+            spec = next((c for c in containers if c.name == container), None)
+            if spec is None:
+                raise NotFound(
+                    f"container {container!r} not found in cell {name!r}"
+                )
+            bare = self._container_context_bare(rec, spec)
+            if self.backend.container_state(bare).running:
+                self.backend.signal_container(bare, _signal.SIGTERM)
+                deadline = time.monotonic() + self.opts.stop_grace_s
+                while (time.monotonic() < deadline
+                       and self.backend.container_state(bare).running):
+                    time.sleep(0.05)
+                if self.backend.container_state(bare).running:
+                    self.backend.signal_container(bare, _signal.SIGKILL)
+            self._ensure_cell_network(rec)
+            ctx = self._container_context(rec, spec)
+            grant = self._chip_slices(containers,
+                                      rec.status.tpu_chips).get(spec.name, [])
+            if grant:
+                # The cell's grant partition is deterministic by declaration
+                # order: the replica comes back on exactly its chips.
+                ctx.env.update(self.devices.visibility_env(grant))
+                ctx.devices = self.devices.device_nodes(grant)
+            self.backend.start_container(ctx)
+            live = self.backend.container_state(ctx)
+            st = rec.status.container(spec.name)
+            if st is None:
+                st = model.ContainerStatus(name=spec.name)
+                rec.status.containers.append(st)
+            st.state = live.state
+            st.pid = live.pid
+            st.exit_code = live.exit_code
+            st.restarts += 1
+            st.last_restart_at = time.time()
+            st.finished_at = None
+            self._m_restarts.inc(cell=self._owner_key(rec),
+                                 container=spec.name)
+            self._derive_phase(rec)
+            self.store.write_cell(rec)
+            return rec
 
     def _container_context_bare(self, rec: model.CellRecord, spec: t.ContainerSpec) -> ContainerContext:
         """Context sufficient for signal/state/cleanup (no env building)."""

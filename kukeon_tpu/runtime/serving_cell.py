@@ -624,6 +624,11 @@ class ServingCell(LifecycleMixin):
             # tier asserts on): queueDepth is live, rejected/timedOut are
             # monotonic totals since boot.
             "queueDepth": int(reg.get("kukeon_engine_queue_depth").value()),
+            # Unfinished engine requests (queued + slotted + mid-dispatch):
+            # the gateway's rollout polls this to see a drain go idle, and
+            # it is the truthful "busy" signal (queueDepth alone reads 0
+            # while slots are full).
+            "inflight": len(self.engine._requests),
             "maxPending": self.engine.max_pending,
             "rejected": self.engine.shed_stats["rejected"],
             "timedOut": self.engine.shed_stats["timed_out"],

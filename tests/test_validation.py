@@ -144,6 +144,27 @@ INVALID = [
         "  model: {model: tiny, port: 8080}\n"
         "  containers: [{name: m, command: [sh], ports: [{port: 8080}]}]"),
      "collides"),
+    ("model-zero-replicas", cell("  model: {model: tiny, replicas: 0}"),
+     "replicas"),
+    ("model-replica-range-overflow", cell(
+        "  model: {model: tiny, port: 65530, replicas: 8}"), "65535"),
+    ("model-replica-port-collides-with-container", cell(
+        "  model: {model: tiny, port: 8080, replicas: 3}\n"
+        # 8082 sits inside the replica range 8080..8083.
+        "  containers: [{name: m, command: [sh], ports: [{port: 8082}]}]"),
+     "collides"),
+    # Cross-document: two ModelSpecs in ONE manifest whose replica port
+    # ranges overlap (9000..9004 vs 9003..9005) — the error names both.
+    ("manifest-replica-port-ranges-collide",
+     cell("  model: {model: tiny, port: 9000, replicas: 4}", name="llm-a")
+     + "\n---\n"
+     + cell("  model: {model: tiny, port: 9003, replicas: 2}", name="llm-b"),
+     "collides with Cell/llm-a"),
+    ("manifest-single-port-inside-replica-range",
+     cell("  model: {model: tiny, port: 9100, replicas: 2}", name="big")
+     + "\n---\n"
+     + cell("  model: {model: tiny, port: 9102}", name="small"),
+     "collides with Cell/big"),
     # --- space networking ------------------------------------------------
     ("egress-bad-default", HEADER + "kind: Space\nmetadata: {name: s}\nspec:\n  network: {egressDefault: maybe}",
      "egressDefault"),
@@ -219,6 +240,13 @@ VALID = [
     ("model-cell", cell(
         "  model: {model: llama3-8b, chips: 8, port: 9000, numSlots: 16,\n"
         "          maxSeqLen: 4096, dtype: int8, hostNetwork: true}")),
+    ("replicated-model-cell", cell(
+        "  model: {model: llama3-8b, chips: 2, port: 9000, replicas: 4}")),
+    # Disjoint replica ranges in one manifest: 9000..9004 then 9005..9007.
+    ("replicated-models-disjoint",
+     cell("  model: {model: tiny, port: 9000, replicas: 4}", name="llm-a")
+     + "\n---\n"
+     + cell("  model: {model: tiny, port: 9005, replicas: 2}", name="llm-b")),
     ("space-deny", HEADER + "kind: Space\nmetadata: {name: s}\nspec:\n"
      "  network:\n    egressDefault: deny\n"
      "    egressAllow:\n      - {host: api.example.com, ports: [443]}\n"
