@@ -171,7 +171,7 @@ def phase_serve(args) -> None:
     engine = ServingEngine(
         cfg, params, mesh, num_slots=sessions, max_seq_len=max_seq,
         decode_chunk=args.decode_chunk, kv_cache_int8=args.kv_int8,
-        prefill_buckets=buckets,
+        prefill_buckets=buckets, kv_page_tokens=args.kv_page_tokens or 0,
     )
 
     _LAT_HISTS = (("ttft", "kukeon_engine_ttft_seconds"),
@@ -265,13 +265,172 @@ def phase_serve(args) -> None:
         "latency_s": latency_percentiles(lat_base),
         "compiles": compiles,
         "peak_hbm_bytes": peak_hbm,
+        "kv_page_tokens": engine.page_tokens,
         "config": {
             "decode_chunk": engine.decode_chunk,
             "kv_cache_int8": engine.kv_cache_int8,
             "prefill_buckets": (list(engine.prefill_buckets)
                                 if buckets else None),
+            "kv_page_tokens": engine.page_tokens,
         },
     }), flush=True)
+
+
+def phase_mixed(args) -> None:
+    """Agent-session workload on a FIXED KV HBM budget (the paged-KV
+    acceptance bench): bimodal prompt/generation lengths, sessions reusing
+    a shared prefix, submitted as one preemption-inducing flood. The same
+    workload runs against the legacy contiguous engine and the paged
+    engine at equal KV rows, and the line reports max concurrent sessions,
+    aggregate tok/s, preemptions, and failures (which must be zero) for
+    each arm — the paged engine's win is concurrency at equal HBM, not a
+    faster single decode step."""
+    import gc
+
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from kukeon_tpu.models import checkpoints, llama
+    from kukeon_tpu.parallel import auto_mesh_shape, make_mesh
+    from kukeon_tpu.serving import SamplingParams, ServingEngine
+
+    backend = jax.default_backend()
+    n_chips = len(jax.devices())
+    shape = auto_mesh_shape(n_chips)
+    mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
+
+    if args.checkpoint:
+        params, cfg = checkpoints.load_quantized(args.checkpoint)
+        model_id = "llama3-8b"
+        max_seq, legacy_slots, paged_slots = 1024, 4, 12
+        pt = args.kv_page_tokens or 64
+        prefix_len, chat_tail, long_tail = 256, 32, 384
+        chat_gen, long_gen, n_sessions = 64, 128, 24
+    else:
+        cfg = llama.llama_tiny()
+        params = llama.init_params(jax.random.key(0), cfg)
+        model_id = "tiny"
+        max_seq, legacy_slots, paged_slots = 128, 2, 4
+        pt = args.kv_page_tokens or 16
+        prefix_len, chat_tail, long_tail = 64, 8, 40
+        chat_gen, long_gen, n_sessions = 32, 24, 24
+
+    # Equal HBM: the paged pool holds exactly the KV rows the legacy
+    # engine reserves up front (legacy_slots * max_seq), carved into
+    # pages. The paged arm gets more decode slots — slots are scheduling
+    # entries there, the pool is what bounds memory.
+    kv_rows = legacy_slots * max_seq
+    pool_pages = kv_rows // pt
+
+    rng = np.random.default_rng(7)
+    prefix = rng.integers(1, cfg.vocab_size, size=prefix_len).astype(np.int32)
+    workload = []            # (prompt, max_new_tokens)
+    for i in range(n_sessions):
+        is_long = i % 2 == 1   # bimodal: half long agent turns, half chatty
+        tail = rng.integers(
+            1, cfg.vocab_size,
+            size=long_tail if is_long else chat_tail).astype(np.int32)
+        workload.append((np.concatenate([prefix, tail]),
+                         long_gen if is_long else chat_gen))
+
+    def run_arm(kv_page_tokens: int, num_slots: int) -> dict:
+        engine = ServingEngine(
+            cfg, params, mesh, num_slots=num_slots, max_seq_len=max_seq,
+            decode_chunk=args.decode_chunk, kv_cache_int8=args.kv_int8,
+            kv_page_tokens=kv_page_tokens,
+            kv_pool_pages=pool_pages if kv_page_tokens else None,
+        )
+        engine.warmup(prefix_len + chat_tail)
+        jax.block_until_ready(engine.params)
+        # Warm the prefix path before measuring: the first shared-prefix
+        # request stores the prefix, the second compiles the extension
+        # prefill (gather + suffix-only programs) — steady-state agent
+        # serving runs warm, and a compile inside the timed flood would
+        # charge one-time cost to the throughput number.
+        for p, gen in (workload[0], workload[1], workload[2]):
+            r = engine.submit(p, SamplingParams(max_new_tokens=gen),
+                              prefix_id="agent")
+            while not r.done.is_set():
+                engine.step()
+        base_preempt = int(engine._m_preempt.value(reason="kv_pressure"))
+        base_hits = engine.prefix_hits
+        t0 = time.monotonic()
+        reqs = [
+            engine.submit(p, SamplingParams(max_new_tokens=gen),
+                          prefix_id="agent")
+            for p, gen in workload
+        ]
+        max_sessions = 0
+        while not all(r.done.is_set() for r in reqs):
+            engine.step()
+            max_sessions = max(
+                max_sessions,
+                sum(1 for r in engine._slot_req if r is not None))
+        dt = time.monotonic() - t0
+        total = sum(len(r.generated) for r in reqs)
+        out = {
+            "max_sessions": max_sessions,
+            "tok_per_s": round(total / dt, 2),
+            "tokens": total,
+            "wall_s": round(dt, 2),
+            "failed": sum(1 for r in reqs if r.error is not None),
+            "preemptions": int(engine._m_preempt.value(
+                reason="kv_pressure")) - base_preempt,
+            "prefix_hits": engine.prefix_hits - base_hits,
+            "compiles": {p: engine.compiles.count(p)
+                         for p in ("prefill", "insert", "decode")},
+        }
+        engine.stop()
+        del engine
+        gc.collect()
+        return out
+
+    _log(f"mixed: legacy arm ({legacy_slots} slots, {kv_rows} KV rows)...")
+    legacy = run_arm(0, legacy_slots)
+    _log(f"mixed legacy: {legacy}")
+    _log(f"mixed: paged arm ({paged_slots} slots, {pool_pages} pages of "
+         f"{pt})...")
+    paged = run_arm(pt, paged_slots)
+    _log(f"mixed paged: {paged}")
+
+    line = {
+        "metric": (f"mixed agent sessions, {model_id}, {n_sessions} "
+                   f"bimodal requests, shared prefix, equal KV HBM "
+                   f"({kv_rows} rows), {n_chips} chip(s) [{backend}]"),
+        "backend": backend,
+        "n_chips": n_chips,
+        "model": model_id,
+        "kv_page_tokens": pt,
+        "kv_pool_pages": pool_pages,
+        "arms": {"legacy": legacy, "paged": paged},
+        "max_sessions_gain": (round(paged["max_sessions"]
+                                    / max(1, legacy["max_sessions"]), 2)),
+        "tok_per_s_gain": (round(paged["tok_per_s"]
+                                 / max(1e-9, legacy["tok_per_s"]), 3)),
+    }
+    if backend == "tpu":
+        try:
+            with open(os.path.join(REPO, "BENCH_TPU_HISTORY.jsonl"), "a") as f:
+                f.write(json.dumps({
+                    "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    "note": "mixed agent-session workload", **line,
+                }) + "\n")
+        except OSError:
+            pass
+    if args.out:
+        serve = {
+            "backend": backend, "n_chips": n_chips, "model": model_id,
+            "model_id": model_id, "sessions": n_sessions,
+            "tok_per_s": paged["tok_per_s"],
+            "trials": [paged["tok_per_s"]],
+            "kv_page_tokens": pt,
+            "max_sessions": paged["max_sessions"],
+            "compiles": paged["compiles"],
+        }
+        write_artifact(args.out, serve, {"mixed": line})
+    print(json.dumps(line), flush=True)
 
 
 def phase_gateway(args) -> None:
@@ -504,6 +663,14 @@ def phase_autotune(args) -> None:
     arms.append((f"chunk{chunks[-1]}+coarse-buckets",
                  {"decode_chunk": chunks[-1], "kv_int8": False,
                   "prefill_buckets": coarse}))
+    # Paged-KV arms: page size is an autotune lever like the others. The
+    # serve phase sizes the pool to its slot count, so these arms measure
+    # the gather/scatter overhead of the paged programs at steady state;
+    # the concurrency upside at equal HBM is phase_mixed's measurement.
+    for pt in ((64, 128) if backend == "tpu" else (16,)):
+        arms.append((f"chunk{chunks[-1]}+paged{pt}",
+                     {"decode_chunk": chunks[-1], "kv_int8": False,
+                      "prefill_buckets": None, "kv_page_tokens": pt}))
 
     results: dict = {}
     best_name, best_cfg, best_rate = None, None, -1.0
@@ -514,6 +681,8 @@ def phase_autotune(args) -> None:
             cmd += ["--kv-int8"]
         if cfg["prefill_buckets"]:
             cmd += ["--prefill-buckets", cfg["prefill_buckets"]]
+        if cfg.get("kv_page_tokens"):
+            cmd += ["--kv-page-tokens", str(cfg["kv_page_tokens"])]
         if qdir:
             cmd += ["--checkpoint", qdir]
         try:
@@ -556,6 +725,7 @@ def phase_autotune(args) -> None:
             decode_chunk=best_cfg["decode_chunk"],
             kv_cache_int8=best_cfg["kv_int8"],
             prefill_buckets=buckets,
+            kv_page_tokens=best_cfg.get("kv_page_tokens"),
             tok_per_s=best_rate,
         ))
         line["best"] = {"arm": best_name, "tok_per_s": round(best_rate, 2)}
@@ -719,7 +889,10 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", default="all",
                     choices=["all", "serve", "embed", "ab", "autotune",
-                             "gateway"])
+                             "gateway", "mixed"])
+    # Mixed agent-session workload at fixed KV HBM (phase_mixed): legacy
+    # vs paged engine, max concurrent sessions + aggregate tok/s per arm.
+    ap.add_argument("--mixed", action="store_true")
     # Scale-out routing benchmark: stand up a replica gateway + N in-process
     # replicas and measure aggregate tok/s + retry rate through the proxy.
     ap.add_argument("--replicas", type=int, default=1)
@@ -736,6 +909,9 @@ def main() -> None:
                     default=os.environ.get("KUKEON_BENCH_KV_INT8", "") == "1")
     # Comma-separated prefill bucket ladder override (e.g. "256,1024,4096").
     ap.add_argument("--prefill-buckets", default=None)
+    # Paged KV cache page size (serving/kv_pages.py): 0/absent = legacy
+    # contiguous layout; > 0 = block-table page pool with this page size.
+    ap.add_argument("--kv-page-tokens", type=int, default=None)
     # Standardized trajectory artifact (e.g. --out BENCH_r06.json): one
     # schema-versioned JSON file per run (kukeon-bench/v2; read_artifact
     # upgrades v1 points) with percentiles, throughput, compile counts,
@@ -746,6 +922,9 @@ def main() -> None:
 
     if args.autotune or args.phase == "autotune":
         phase_autotune(args)
+        return
+    if args.mixed or args.phase == "mixed":
+        phase_mixed(args)
         return
     if args.phase == "gateway" or args.replicas > 1:
         phase_gateway(args)
@@ -898,18 +1077,24 @@ def main() -> None:
 
 def read_artifact(path: str) -> dict:
     """Read a BENCH_rNN.json trajectory artifact, upgrading older schemas
-    in place: a kukeon-bench/v1 point (pre-gateway) is a single-engine
-    measurement, so it reads back as v2 with ``replicas: 1`` — trajectory
-    tooling compares one shape across rounds."""
+    in place so trajectory tooling compares one shape across rounds: a
+    kukeon-bench/v1 point (pre-gateway) is a single-engine measurement and
+    gains ``replicas: 1``; v1/v2 points (pre-paged-KV) gain
+    ``kv_page_tokens: 0`` (the legacy contiguous layout) and
+    ``max_sessions`` equal to their session count (every session a legacy
+    point ran was concurrently resident)."""
     with open(path) as f:
         artifact = json.load(f)
     schema = artifact.get("schema")
-    if schema == "kukeon-bench/v1":
-        artifact = dict(artifact)
-        artifact["schema"] = "kukeon-bench/v2"
-        artifact.setdefault("replicas", 1)
-    elif schema != "kukeon-bench/v2":
+    if schema not in ("kukeon-bench/v1", "kukeon-bench/v2",
+                     "kukeon-bench/v3"):
         raise ValueError(f"unknown bench artifact schema {schema!r} in {path}")
+    if schema != "kukeon-bench/v3":
+        artifact = dict(artifact)
+        artifact.setdefault("replicas", 1)              # v1 -> v2
+        artifact.setdefault("kv_page_tokens", 0)        # v2 -> v3
+        artifact.setdefault("max_sessions", artifact.get("sessions"))
+        artifact["schema"] = "kukeon-bench/v3"
     return artifact
 
 
@@ -917,7 +1102,7 @@ def write_artifact(path: str, serve: dict, result: dict) -> None:
     """The standardized BENCH_rNN.json trajectory point: fixed schema, one
     file per run, every field from the product's own instruments."""
     artifact = {
-        "schema": "kukeon-bench/v2",
+        "schema": "kukeon-bench/v3",
         "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "backend": serve["backend"],
         "n_chips": serve["n_chips"],
@@ -933,8 +1118,17 @@ def write_artifact(path: str, serve: dict, result: dict) -> None:
         "latency_s": serve.get("latency_s"),
         "compiles": serve.get("compiles"),
         "peak_hbm_bytes": serve.get("peak_hbm_bytes"),
+        # v3: KV page size the measured engine served from (0 = legacy
+        # contiguous layout) and the peak number of concurrently resident
+        # sessions — the paged cache's headline number (--mixed drives it
+        # past the legacy slot count at equal HBM).
+        "kv_page_tokens": serve.get(
+            "kv_page_tokens", (serve.get("config") or {}).get(
+                "kv_page_tokens", 0)),
+        "max_sessions": serve.get("max_sessions", serve.get("sessions")),
         "cold_start": result.get("cold_start"),
         "embedding": result.get("embedding"),
+        "mixed": result.get("mixed"),
     }
     try:
         with open(path, "w") as f:

@@ -53,6 +53,7 @@ POINTS = (
     "engine.decode",
     "engine.fetch",
     "engine.upload",
+    "kv.alloc",
     "cell.http",
     "checkpoint.save",
     "checkpoint.load",

@@ -178,6 +178,13 @@ class ModelSpec:
     # into the attention dots). Weights are governed by ``dtype``; this
     # governs only the per-request KV cache.
     kv_cache_int8: bool = False
+    # Paged KV cache (serving/kv_pages.py): > 0 serves from a block-table
+    # page pool with pages of this many KV rows instead of reserving
+    # numSlots * maxSeqLen contiguous rows per slot — mixed-length agent
+    # traffic packs HBM page-granularly, with preemption + requeue under
+    # pressure and refcounted prefix sharing. 0 forces the legacy
+    # contiguous layout; None defers to the persisted autotune profile.
+    kv_page_tokens: int | None = None
     # Admission control (serving resilience): bound on queued-not-yet-
     # slotted requests — past it the cell sheds with 429 + Retry-After
     # instead of growing an unbounded backlog. None = the serving cell's

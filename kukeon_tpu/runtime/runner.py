@@ -316,6 +316,10 @@ class Runner:
             cmd += ["--dtype", m.dtype]
         if m.kv_cache_int8:
             cmd += ["--kv-cache-int8"]
+        if m.kv_page_tokens is not None:
+            # 0 is meaningful (pin the legacy contiguous layout even when a
+            # tuning profile prefers pages) — pass it through.
+            cmd += ["--kv-page-tokens", str(m.kv_page_tokens)]
         if m.max_pending is not None:
             # 0 is meaningful (explicit unbounded opt-out) — pass it through.
             cmd += ["--max-pending", str(m.max_pending)]

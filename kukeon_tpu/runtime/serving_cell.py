@@ -290,6 +290,7 @@ class ServingCell(LifecycleMixin):
                  checkpoint: str | None, dtype: str | None, seed: int = 0,
                  kv_cache_int8: bool | None = None,
                  decode_chunk: int | None = None,
+                 kv_page_tokens: int | None = None,
                  max_pending: int | None = None,
                  deadline_s: float | None = None,
                  slo_ttft_p95_ms: float | None = None,
@@ -388,6 +389,7 @@ class ServingCell(LifecycleMixin):
             kv_cache_int8=kv_cache_int8, async_load=True,
             forward_fn=forward_fn, param_specs=param_specs,
             decode_chunk=decode_chunk, model_name=model,
+            kv_page_tokens=kv_page_tokens,
             max_pending=max_pending, registry=registry,
         )
         from kukeon_tpu.serving.tokenizer import load_tokenizer
@@ -618,7 +620,18 @@ class ServingCell(LifecycleMixin):
             "tuning": {
                 "decodeChunk": self.engine.decode_chunk,
                 "kvCacheInt8": self.engine.kv_cache_int8,
+                "kvPageTokens": self.engine.page_tokens,
                 "fromProfile": self.engine.tune is not None,
+            },
+            # Paged KV pool occupancy (0/0 on the legacy layout): what the
+            # operator watches to size kvPageTokens / the pool.
+            "kvPages": {
+                "total": self.engine.kv_pool_pages,
+                "inUse": (self.engine._pool.in_use
+                          if self.engine._pool is not None else 0),
+                "preemptions": int(reg.get(
+                    "kukeon_preemptions_total").value(reason="kv_pressure")),
+                "shedKvExhausted": self.engine.shed_stats["kv_exhausted"],
             },
             # Overload/lifecycle counters (the shed accounting the stress
             # tier asserts on): queueDepth is live, rejected/timedOut are
@@ -1044,6 +1057,9 @@ def main(argv=None) -> int:
     # explicit flag always wins (serving/tuning.py).
     ap.add_argument("--kv-cache-int8", action="store_true", default=None)
     ap.add_argument("--decode-chunk", type=int, default=None)
+    # Paged KV cache (ModelSpec kvPageTokens): > 0 = page size in KV rows,
+    # 0 = pin the legacy contiguous layout, absent = profile decides.
+    ap.add_argument("--kv-page-tokens", type=int, default=None)
     ap.add_argument("--no-warmup", action="store_true")
     # Admission control: bound the pending queue (shed with 429 past it)
     # and default every request to a deadline (expired requests free their
@@ -1069,6 +1085,7 @@ def main(argv=None) -> int:
             args.model, num_slots=args.num_slots, max_seq_len=args.max_seq_len,
             checkpoint=args.checkpoint, dtype=args.dtype,
             kv_cache_int8=args.kv_cache_int8, decode_chunk=args.decode_chunk,
+            kv_page_tokens=args.kv_page_tokens,
             max_pending=args.max_pending or None,
             deadline_s=args.deadline_s or None,
             slo_ttft_p95_ms=args.slo_ttft_p95_ms or None,

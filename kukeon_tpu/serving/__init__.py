@@ -6,6 +6,11 @@ from kukeon_tpu.serving.engine import (  # noqa: F401
     ServingEngine,
     bucket_length,
 )
+from kukeon_tpu.serving.kv_pages import (  # noqa: F401
+    PageAllocator,
+    PagePoolExhausted,
+    SharedPrefix,
+)
 from kukeon_tpu.serving.sampling import (  # noqa: F401
     SamplingParams,
     sample,

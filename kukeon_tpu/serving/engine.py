@@ -8,6 +8,21 @@ core, designed for TPU:
 - **Slot-based decode batch**: a fixed [B_slots] decode batch with a
   fixed-shape KV cache [L, B, S_max, KV, D]. Static shapes => one compiled
   decode program; occupancy changes never recompile.
+- **Paged KV cache (``kv_page_tokens > 0``)**: instead of reserving
+  ``num_slots * S_max`` contiguous rows, HBM is owned as fixed-size pages
+  ([L, P, page_tokens, KV, D], serving/kv_pages.py) with a per-slot block
+  table threaded into the jitted programs — gather/scatter by page index
+  replaces slot-contiguous cache views. Pages alloc/free page-granularly as
+  requests are admitted, grow, and finish, so mixed-length agent traffic
+  packs the chip instead of fragmenting it; under memory pressure the
+  lowest-priority in-flight request is *preempted* (pages reclaimed,
+  request requeued ahead of new admissions, re-prefilled on resume), and
+  prefix-cache entries become shared read-only pages with refcounts — N
+  sessions on one agent prefix pay its KV cost once. The block table is a
+  [B, S_max/page_tokens] int32 array with static shape, so the decode
+  program still never recompiles across occupancy churn, and it is
+  device-cached with a dirty flag like the sampling arrays, so steady-state
+  chunks still perform exactly one blocking transfer (the token fetch).
 - **Disaggregated prefill/insert/decode programs**: prefill runs per request
   at a small set of bucketed lengths (bounded compile cache), its KV block is
   inserted into a free slot, and the decode program generates tokens for
@@ -50,6 +65,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from kukeon_tpu import faults
 from kukeon_tpu.models import llama
+from kukeon_tpu.serving.kv_pages import (
+    SCRATCH_PAGE,
+    PageAllocator,
+    PagePoolExhausted,
+    SharedPrefix,
+)
 from kukeon_tpu.obs import (
     CompileTracker,
     Registry,
@@ -150,6 +171,13 @@ class Request:
     # growing conversation): requests with the same prefix_id reuse the
     # stored prompt KV and prefill only the new suffix.
     prefix_id: str | None = None
+    # Paged-KV preemption (kv_pages): a preempted request lost its slot and
+    # pages under memory pressure; it sits in the resume queue (ahead of new
+    # admissions) and re-prefills prompt+generated when re-admitted.
+    # ``requeued`` also marks that the request already left the _pending_n
+    # admission count — terminal paths must not decrement it again.
+    preemptions: int = 0
+    requeued: bool = False
 
     def cancel(self) -> None:
         """Ask the engine to stop generating for this request. Thread-safe:
@@ -225,6 +253,8 @@ class ServingEngine:
         max_pending: int | None = None,
         registry: Registry | None = None,
         trace_capacity: int = 512,
+        kv_page_tokens: int | None = None,
+        kv_pool_pages: int | None = None,
     ):
         # Model pluggability: any forward with llama.forward's signature
         # ((params, cfg, tokens, positions, cache) -> (logits, cache')) and
@@ -252,7 +282,8 @@ class ServingEngine:
         # or missing one silently degrades to defaults (serving/tuning.py).
         self.tune: "Any | None" = None
         if model_name and (decode_chunk is None or kv_cache_int8 is None
-                           or prefill_buckets is None):
+                           or prefill_buckets is None
+                           or kv_page_tokens is None):
             from kukeon_tpu.serving import tuning
 
             self.tune = tuning.load(
@@ -266,6 +297,10 @@ class ServingEngine:
                 kv_cache_int8 = self.tune.kv_cache_int8
             if prefill_buckets is None:
                 prefill_buckets = self.tune.prefill_buckets
+            # kv_page_tokens: None = let the profile decide, 0 = force the
+            # legacy contiguous layout, > 0 = paged with that page size.
+            if kv_page_tokens is None:
+                kv_page_tokens = self.tune.kv_page_tokens
         decode_chunk = 16 if decode_chunk is None else decode_chunk
         kv_cache_int8 = bool(kv_cache_int8)
         self.model_name = model_name
@@ -308,6 +343,43 @@ class ServingEngine:
         self.max_seq_len = max_seq_len or cfg.max_seq_len
         self.eos_ids = set(eos_ids)
         self.decode_chunk = max(1, decode_chunk)
+        # Paged KV cache (serving/kv_pages.py): pages of ``kv_page_tokens``
+        # rows replace the slot-contiguous [B, S_max] reservation. Shapes
+        # stay static — the decode view is always [B, S_max] — but only the
+        # pages a request actually uses are allocated, so the pool can be
+        # sized well below num_slots * S_max and preemption absorbs the
+        # overflow. page size must tile max_seq_len and every usable prefill
+        # bucket, or insert-time scatters would split a page across slots.
+        self.page_tokens = int(kv_page_tokens or 0)
+        self.paged = self.page_tokens > 0
+        self._pool: PageAllocator | None = None
+        if self.paged:
+            pt = self.page_tokens
+            if self.max_seq_len % pt:
+                raise ValueError(
+                    f"kv_page_tokens {pt} must divide max_seq_len "
+                    f"{self.max_seq_len}")
+            bad = [b for b in self.prefill_buckets
+                   if b < self.max_seq_len and b % pt]
+            if bad:
+                raise ValueError(
+                    f"kv_page_tokens {pt} must divide every prefill bucket "
+                    f"below max_seq_len; offending buckets: {bad}")
+            self.max_pages_per_slot = self.max_seq_len // pt
+            self.kv_pool_pages = int(
+                kv_pool_pages or num_slots * self.max_pages_per_slot)
+            self._pool = PageAllocator(self.kv_pool_pages, pt)
+            # Per-page HBM bytes (K + V + scales): what a prefix entry pins
+            # against the prefix-cache byte budget in paged mode.
+            row = cfg.num_layers * cfg.num_kv_heads * cfg.head_dim
+            itemsize = 1 if kv_cache_int8 else np.dtype(cfg.dtype).itemsize
+            self._page_bytes = 2 * pt * row * itemsize
+            if kv_cache_int8:
+                self._page_bytes += (
+                    2 * pt * cfg.num_layers * cfg.num_kv_heads * 4)
+        else:
+            self.max_pages_per_slot = 0
+            self.kv_pool_pages = 0
         # int8 KV cache: halves the cache's HBM bytes per decode step (the
         # stream that grows with context length and slot count); dequant is
         # fused into the decode attention dots. Prefill stays full-precision;
@@ -372,6 +444,25 @@ class ServingEngine:
         # host memory at all — not even a numpy rebuild-and-compare.
         self._sampling_dev: tuple | None = None
         self._sampling_dirty = True
+        # Paged block tables: host truth is a [B, max_pages] int32 array
+        # (released slots zeroed -> their in-flight writes land in scratch);
+        # the device copy re-uploads only when a slot's page list changed —
+        # same dirty-flag discipline as the sampling arrays, so steady-state
+        # decode chunks still touch no host memory.
+        self._bt = (np.zeros((num_slots, self.max_pages_per_slot), np.int32)
+                    if self.paged else None)
+        self._bt_dev = None
+        self._bt_dirty = True
+        self._slot_pages: list[list[int]] = [[] for _ in range(num_slots)]
+        # Device-side length each slot's dispatched work will have reached
+        # (insert length + every dispatched chunk step): what page growth is
+        # planned against.
+        self._slot_disp: list[int] = [0] * num_slots
+        # Preempted requests wait here and are re-admitted BEFORE anything
+        # in _pending — a preempted request resumes ahead of new admissions.
+        from collections import deque as _deque
+
+        self._resume: "Any" = _deque()
         self._pending: queue.Queue[Request] = queue.Queue()
         self._next_id = 0
         self._lock = threading.Lock()
@@ -421,17 +512,37 @@ class ServingEngine:
             "kukeon_engine_shed_total",
             "Load-shedding events (rejected = queue full at submit, "
             "timed_out = deadline expired).", labels=("reason",))
-        # The PR-2 shed dict is now a registry view (same keys, same reads).
+        # The PR-2 shed dict is now a registry view (same keys, same reads;
+        # kv_exhausted joined with the paged allocator — a request shed
+        # because the KV page pool ran dry with nothing reclaimable).
         self.shed_stats = _CounterMapView(
-            self._m_shed, "reason", ("rejected", "timed_out"))
+            self._m_shed, "reason", ("rejected", "timed_out", "kv_exhausted"))
+        # Paged-KV telemetry. Families are declared in every mode so the
+        # scrape schema is stable; a legacy engine reports a 0-page pool.
+        reg.gauge("kukeon_kv_pages_total",
+                  "Usable KV pool pages (0 = legacy contiguous layout)."
+                  ).set(self.kv_pool_pages)
+        reg.gauge("kukeon_kv_pages_in_use",
+                  "KV pool pages currently allocated.").set_function(
+            lambda: float(self._pool.in_use) if self._pool else 0.0)
+        reg.gauge("kukeon_kv_prefix_shared_pages",
+                  "Distinct pool pages pinned by prefix-cache entries "
+                  "(shared read-only across sessions).").set_function(
+            self._prefix_shared_pages)
+        self._m_preempt = reg.counter(
+            "kukeon_preemptions_total",
+            "In-flight requests preempted (pages reclaimed, request "
+            "requeued ahead of new admissions), by reason.",
+            labels=("reason",))
         reg.gauge("kukeon_engine_slots_total",
                   "Decode slots in the fixed batch.").set(num_slots)
         reg.gauge("kukeon_engine_slots_free",
                   "Slots with no active request.").set_function(
             lambda: len(self._free_slots()))
         reg.gauge("kukeon_engine_queue_depth",
-                  "Admitted-not-yet-slotted requests.").set_function(
-            lambda: self._pending_n)
+                  "Requests waiting for a slot (admitted-not-yet-slotted "
+                  "plus preempted-awaiting-resume).").set_function(
+            lambda: self._pending_n + len(self._resume))
         reg.gauge("kukeon_engine_max_pending",
                   "Admission bound (-1 = unbounded).").set(
             -1 if max_pending is None else max_pending)
@@ -487,10 +598,26 @@ class ServingEngine:
                 NamedSharding(self.mesh, PartitionSpec(*spec[:4])))
 
     def _init_state(self) -> DecodeState:
-        cache = llama.KVCache.create(
-            self.cfg, self.num_slots, self.max_seq_len,
-            quantized=self.kv_cache_int8,
-        )
+        if self.paged:
+            # Pool layout: page axis where the legacy cache has its slot
+            # axis ([L, P, page_tokens, KV, D]); lengths stay per-SLOT [B]
+            # (the pool has no per-page length — the block table says which
+            # pages a slot's logical [0, S_max) range maps to). Page 0 is
+            # the scratch page (kv_pages.SCRATCH_PAGE).
+            cache = llama.KVCache.create(
+                self.cfg, self.kv_pool_pages + 1, self.page_tokens,
+                quantized=self.kv_cache_int8,
+            )
+            cache = llama.KVCache(
+                k=cache.k, v=cache.v,
+                lengths=jnp.zeros((self.num_slots,), jnp.int32),
+                k_scale=cache.k_scale, v_scale=cache.v_scale,
+            )
+        else:
+            cache = llama.KVCache.create(
+                self.cfg, self.num_slots, self.max_seq_len,
+                quantized=self.kv_cache_int8,
+            )
         kv_sharding, sc_sharding = self._cache_shardings()
         cache = llama.KVCache(
             k=jax.device_put(cache.k, kv_sharding),
@@ -637,6 +764,168 @@ class ServingEngine:
             (state, _), toks = jax.lax.scan(body, (state, key), length=n_steps)
             return state, toks.T  # [B, K]
 
+        # --- paged variants (block-table gather/scatter) ------------------
+        # The pool is [L, P, pt, KV, D]; a slot's logical [0, S_max) range
+        # is the concatenation of its block-table pages. All three programs
+        # keep static shapes (the block table is always [B, max_pages]), so
+        # occupancy churn and page churn never recompile.
+        pt_sz = self.page_tokens
+        B_slots = self.num_slots
+        S_max = self.max_seq_len
+
+        def gather_block(pool_k, pool_v, pool_ks, pool_vs, page_ids):
+            """Pool pages -> one dense full-precision block [L, 1, n*pt,
+            KV, D] (the prefix-extension prefill's input). Scratch-padded
+            page_ids gather garbage rows that the consumer masks by length;
+            a quantized pool is dequantized here (f32 product, cast down —
+            the same recipe the fused decode path applies)."""
+            k = pool_k[:, page_ids]          # [L, n, pt, KV, D]
+            v = pool_v[:, page_ids]
+            L, n = k.shape[0], page_ids.shape[0]
+            k = k.reshape(L, 1, n * pt_sz, *k.shape[3:])
+            v = v.reshape(L, 1, n * pt_sz, *v.shape[3:])
+            if pool_ks is not None:
+                ks = pool_ks[:, page_ids].reshape(L, 1, n * pt_sz, -1)
+                vs = pool_vs[:, page_ids].reshape(L, 1, n * pt_sz, -1)
+                k = (k.astype(jnp.float32)
+                     * ks[..., None].astype(jnp.float32)).astype(cfg.dtype)
+                v = (v.astype(jnp.float32)
+                     * vs[..., None].astype(jnp.float32)).astype(cfg.dtype)
+            return k, v
+
+        def insert_paged(state: DecodeState, kv_k, kv_v, length, page_ids,
+                         slot, token):
+            """Scatter a prefill's [L, 1, Sb, KV, D] block into the pool by
+            page index and activate ``slot``.
+
+            page_ids[i] is the pool destination of block rows
+            [i*pt, (i+1)*pt) — the host passes SCRATCH_PAGE for pages it
+            must not write (shared prefix pages stay read-only, bucket
+            padding goes nowhere), so one compiled program per bucket covers
+            every share/pad combination."""
+            ks = vs = None
+            if state.cache.quantized:
+                kv_k, ks = llama.quantize_kv(kv_k)
+                kv_v, vs = llama.quantize_kv(kv_v)
+            L = kv_k.shape[0]
+            nb = page_ids.shape[0]
+            cache = state.cache
+            new_k = cache.k.at[:, page_ids].set(
+                kv_k.reshape(L, nb, pt_sz, *kv_k.shape[3:]))
+            new_v = cache.v.at[:, page_ids].set(
+                kv_v.reshape(L, nb, pt_sz, *kv_v.shape[3:]))
+            k_scale, v_scale = cache.k_scale, cache.v_scale
+            if ks is not None:
+                k_scale = k_scale.at[:, page_ids].set(
+                    ks.reshape(L, nb, pt_sz, -1))
+                v_scale = v_scale.at[:, page_ids].set(
+                    vs.reshape(L, nb, pt_sz, -1))
+            cache = llama.KVCache(
+                k=new_k, v=new_v,
+                lengths=cache.lengths.at[slot].set(length),
+                k_scale=k_scale, v_scale=v_scale,
+            )
+            return DecodeState(
+                cache=cache,
+                tokens=state.tokens.at[slot].set(token),
+                active=state.active.at[slot].set(True),
+            )
+
+        def decode_chunk_paged(params, state: DecodeState, bt, key, temps,
+                               top_ks, top_ps, n_steps):
+            """K decode steps over the paged pool, dense-view pipelined:
+            gather every slot's pages into the [L, B, S_max, KV, D] view
+            the model forward already speaks ONCE, run the whole chunk on
+            that view (the exact per-step cost of the legacy layout), then
+            scatter the chunk's new K/V rows back to their (page, offset)
+            homes in one flattened vectorized write. Amortizing the
+            gather/scatter over K steps is what keeps the paged layout's
+            per-token cost at parity with the contiguous one; the dense
+            view is a transient buffer that lives only for the chunk —
+            persistent HBM is still just the page pool.
+
+            Inactive slots' lengths never advance, and released slots'
+            block tables are zeroed host-side, so their stray write-back
+            rows flat-map into the scratch page (duplicate scratch
+            destinations are harmless — nobody reads scratch) — never
+            into a page that was re-issued to another request."""
+            pool = state.cache
+            L = pool.k.shape[0]
+            start_lengths = pool.lengths
+            view_k = pool.k[:, bt].reshape(
+                L, B_slots, S_max, *pool.k.shape[3:])
+            view_v = pool.v[:, bt].reshape(
+                L, B_slots, S_max, *pool.v.shape[3:])
+            vks = vvs = None
+            if pool.quantized:
+                vks = pool.k_scale[:, bt].reshape(L, B_slots, S_max, -1)
+                vvs = pool.v_scale[:, bt].reshape(L, B_slots, S_max, -1)
+            view = llama.KVCache(k=view_k, v=view_v, lengths=start_lengths,
+                                 k_scale=vks, v_scale=vvs)
+            vstate = DecodeState(cache=view, tokens=state.tokens,
+                                 active=state.active)
+
+            def body(carry, _):
+                st, key = carry
+                tokens = st.tokens[:, None]
+                lengths_before = st.cache.lengths
+                positions = lengths_before[:, None]
+                logits, cache = fwd(params, cfg, tokens, positions, st.cache)
+                # Inactive slots must not advance their cache length.
+                cache = dataclasses.replace(
+                    cache,
+                    lengths=jnp.where(st.active, cache.lengths,
+                                      lengths_before),
+                )
+                key, k1 = jax.random.split(key)
+                next_tokens = sample_per_slot(
+                    logits[:, 0, :], k1, temps, top_ks, top_ps)
+                next_tokens = jnp.where(st.active, next_tokens, st.tokens)
+                new_state = DecodeState(cache=cache, tokens=next_tokens,
+                                        active=st.active)
+                return (new_state, key), next_tokens
+
+            (vstate, _), toks = jax.lax.scan(body, (vstate, key),
+                                             length=n_steps)
+
+            # Write-back: row t of slot b (absolute position
+            # start_lengths[b] + t) lands at flat pool row
+            # bt[b, pos // pt] * pt + pos % pt. Positions are clamped to
+            # the view bound for slots frozen near S_max — their zeroed /
+            # stale table rows route the write to scratch anyway.
+            bidx = jnp.arange(B_slots)
+            pos = jnp.minimum(
+                start_lengths[:, None] + jnp.arange(n_steps)[None, :],
+                S_max - 1,
+            )                                                  # [B, K]
+            page = bt[bidx[:, None],
+                      jnp.minimum(pos // pt_sz, bt.shape[1] - 1)]
+            dest = (page * pt_sz + pos % pt_sz).reshape(-1)    # [B*K]
+            rows_k = vstate.cache.k[:, bidx[:, None], pos]     # [L, B, K, ...]
+            rows_v = vstate.cache.v[:, bidx[:, None], pos]
+            pk = pool.k.reshape(L, -1, *pool.k.shape[3:]).at[:, dest].set(
+                rows_k.reshape(L, -1, *rows_k.shape[3:])
+            ).reshape(pool.k.shape)
+            pv = pool.v.reshape(L, -1, *pool.v.shape[3:]).at[:, dest].set(
+                rows_v.reshape(L, -1, *rows_v.shape[3:])
+            ).reshape(pool.v.shape)
+            pks, pvs = pool.k_scale, pool.v_scale
+            if pks is not None:
+                rows_ks = vstate.cache.k_scale[:, bidx[:, None], pos]
+                rows_vs = vstate.cache.v_scale[:, bidx[:, None], pos]
+                pks = pks.reshape(L, -1, pks.shape[3]).at[:, dest].set(
+                    rows_ks.reshape(L, -1, rows_ks.shape[3])
+                ).reshape(pool.k_scale.shape)
+                pvs = pvs.reshape(L, -1, pvs.shape[3]).at[:, dest].set(
+                    rows_vs.reshape(L, -1, rows_vs.shape[3])
+                ).reshape(pool.v_scale.shape)
+            new_cache = llama.KVCache(k=pk, v=pv,
+                                      lengths=vstate.cache.lengths,
+                                      k_scale=pks, v_scale=pvs)
+            new_state = DecodeState(cache=new_cache, tokens=vstate.tokens,
+                                    active=state.active)
+            return new_state, toks.T  # [B, K]
+
         # Every program dispatches through the compile tracker: a dispatch
         # that grew the jit tracing cache is counted + timed by program
         # (prefill covers both the cold and prefix-extend variants). The
@@ -647,6 +936,14 @@ class ServingEngine:
         self._insert = ct.wrap(jax.jit(insert, donate_argnums=(0,)), "insert")
         self._decode_chunk = ct.wrap(
             jax.jit(decode_chunk_fn, static_argnums=(6,), donate_argnums=(1,)),
+            "decode",
+        )
+        self._gather_block = ct.wrap(jax.jit(gather_block), "prefill")
+        self._insert_paged = ct.wrap(
+            jax.jit(insert_paged, donate_argnums=(0,)), "insert")
+        self._decode_chunk_paged = ct.wrap(
+            jax.jit(decode_chunk_paged, static_argnums=(7,),
+                    donate_argnums=(1,)),
             "decode",
         )
 
@@ -724,12 +1021,25 @@ class ServingEngine:
 
     def _abstract_state(self) -> DecodeState:
         """ShapeDtypeStruct mirror of _init_state (no device bytes)."""
-        shapes = jax.eval_shape(
-            lambda: llama.KVCache.create(
-                self.cfg, self.num_slots, self.max_seq_len,
-                quantized=self.kv_cache_int8,
+        if self.paged:
+            shapes = jax.eval_shape(
+                lambda: llama.KVCache.create(
+                    self.cfg, self.kv_pool_pages + 1, self.page_tokens,
+                    quantized=self.kv_cache_int8,
+                )
             )
-        )
+            shapes = llama.KVCache(
+                k=shapes.k, v=shapes.v,
+                lengths=jax.ShapeDtypeStruct((self.num_slots,), jnp.int32),
+                k_scale=shapes.k_scale, v_scale=shapes.v_scale,
+            )
+        else:
+            shapes = jax.eval_shape(
+                lambda: llama.KVCache.create(
+                    self.cfg, self.num_slots, self.max_seq_len,
+                    quantized=self.kv_cache_int8,
+                )
+            )
         kv_sh, sc_sh = self._cache_shardings()
         repl = NamedSharding(self.mesh, PartitionSpec())
 
@@ -781,18 +1091,32 @@ class ServingEngine:
                 ).compile()
                 kv_shape = (cfg.num_layers, 1, L, cfg.num_kv_heads, cfg.head_dim)
                 kv = jax.ShapeDtypeStruct(kv_shape, cfg.dtype)
-                self._insert.lower(
-                    astate, kv, kv, L // 2, 0, jnp.int32(1),
-                ).compile()
+                if self.paged:
+                    ids = jax.ShapeDtypeStruct((L // self.page_tokens,),
+                                               jnp.int32)
+                    self._insert_paged.lower(
+                        astate, kv, kv, L // 2, ids, 0, jnp.int32(1),
+                    ).compile()
+                else:
+                    self._insert.lower(
+                        astate, kv, kv, L // 2, 0, jnp.int32(1),
+                    ).compile()
             chunk_sizes = {1, 4}
             size = 1
             while size * 4 <= self.decode_chunk:
                 size *= 4
                 chunk_sizes.add(size)
+            bt = jax.ShapeDtypeStruct(
+                (B, self.max_pages_per_slot), jnp.int32)
             for k in sorted(chunk_sizes):
-                self._decode_chunk.lower(
-                    aparams, astate, key, temps, top_ks, top_ps, k,
-                ).compile()
+                if self.paged:
+                    self._decode_chunk_paged.lower(
+                        aparams, astate, bt, key, temps, top_ks, top_ps, k,
+                    ).compile()
+                else:
+                    self._decode_chunk.lower(
+                        aparams, astate, key, temps, top_ks, top_ps, k,
+                    ).compile()
 
     # --- public API --------------------------------------------------------
 
@@ -811,6 +1135,16 @@ class ServingEngine:
             raise ValueError(
                 f"prompt length {prompt.size} >= engine max_seq_len {self.max_seq_len}"
             )
+        if self.paged:
+            need = self._pool.pages_for(int(prompt.size) + 1)
+            if need > self._pool.num_pages:
+                # Even an empty pool could never hold this prompt: fail at
+                # submit like the max_seq_len check — waiting would deadlock.
+                raise ValueError(
+                    f"prompt needs {need} KV pages but the pool holds "
+                    f"{self._pool.num_pages} (kv_page_tokens="
+                    f"{self.page_tokens})"
+                )
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError("deadline_s must be positive")
         now = time.monotonic()
@@ -850,13 +1184,15 @@ class ServingEngine:
 
     @property
     def queue_depth(self) -> int:
-        """Requests admitted but not yet slotted (the shed threshold)."""
-        return self._pending_n
+        """Requests waiting for a slot: fresh admissions plus preempted
+        requests parked for resume. The admission bound (max_pending)
+        counts only the former — preemption must never cause sheds."""
+        return self._pending_n + len(self._resume)
 
     def stalled_s(self) -> float:
         """Seconds since the engine last made progress WHILE work is
         outstanding; 0.0 when idle (an idle engine is never stalled)."""
-        if self._pending_n == 0 and not any(
+        if self._pending_n == 0 and not self._resume and not any(
             r is not None for r in self._slot_req
         ):
             return 0.0
@@ -902,10 +1238,18 @@ class ServingEngine:
         with set_mesh(self.mesh):
             for k in sorted(chunk_sizes):
                 self._key, k1 = jax.random.split(self._key)
-                self.state, _ = self._decode_chunk(
-                    self.params, self.state, k1,
-                    jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps), k,
-                )
+                if self.paged:
+                    self.state, _ = self._decode_chunk_paged(
+                        self.params, self.state, self._bt_dev_array(), k1,
+                        jnp.asarray(temps), jnp.asarray(top_ks),
+                        jnp.asarray(top_ps), k,
+                    )
+                else:
+                    self.state, _ = self._decode_chunk(
+                        self.params, self.state, k1,
+                        jnp.asarray(temps), jnp.asarray(top_ks),
+                        jnp.asarray(top_ps), k,
+                    )
 
     def start(self):
         """Run the engine loop on a background thread."""
@@ -940,6 +1284,17 @@ class ServingEngine:
                     self._slot_len = [0] * self.num_slots
                     self._inflight = None
                     self._sampling_dirty = True
+                    if self.paged:
+                        # The pool device tensor was rebuilt: every page and
+                        # every prefix entry pointing into the old one is
+                        # void. Start the allocator over.
+                        self._pool = PageAllocator(self.kv_pool_pages,
+                                                   self.page_tokens)
+                        self._slot_pages = [[] for _ in range(self.num_slots)]
+                        self._slot_disp = [0] * self.num_slots
+                        self._bt[:] = 0
+                        self._bt_dirty = True
+                        self._prefix_cache.clear()
                 except Exception:  # noqa: BLE001
                     self._running = False
                     raise
@@ -965,8 +1320,16 @@ class ServingEngine:
         forever (same contract as the cancel paths)."""
         for slot, req in list(self._active_requests()):
             self._slot_req[slot] = None
+            if self.paged:
+                self._pool.unref(self._slot_pages[slot])
+                self._slot_pages[slot] = []
+                self._slot_disp[slot] = 0
+                self._bt[slot, :] = 0
+                self._bt_dirty = True
             self._fail_request(req, exc)
         self._sampling_dirty = True
+        while self._resume:
+            self._fail_request(self._resume.popleft(), exc)
         while True:
             try:
                 req = self._pending.get_nowait()
@@ -1008,6 +1371,22 @@ class ServingEngine:
                 )
                 self._release_slot(req, timed_out=True)
                 did = True
+        # Preempted requests parked for resume observe cancellation and
+        # deadlines too — a preempted request must still respect its
+        # deadline while it waits for pages.
+        if self._resume:
+            kept_resume = []
+            for req in self._resume:
+                if req.cancelled:
+                    self._finish_cancelled(req, counted=False)
+                    did = True
+                elif self._expired(req, now):
+                    self._finish_timeout(req, counted=False)
+                    did = True
+                else:
+                    kept_resume.append(req)
+            self._resume.clear()
+            self._resume.extend(kept_resume)
         # Drain-and-refill: Queue supports no removal. Concurrent submits
         # during the refill just land behind the kept entries.
         kept: list[Request] = []
@@ -1028,22 +1407,26 @@ class ServingEngine:
             self._pending.put(req)
         return did
 
-    def _finish_cancelled(self, req: Request) -> None:
-        """Complete a never-started cancelled request (no slot involved)."""
+    def _finish_cancelled(self, req: Request, counted: bool = True) -> None:
+        """Complete an unslotted cancelled request (``counted=False`` for
+        preempted requests, which already left the admission count)."""
         with self._lock:
             self._requests.pop(req.id, None)
-            self._pending_n -= 1
+            if counted:
+                self._pending_n -= 1
         self._observe_terminal(req, "cancelled")
         if req.emit:
             req.emit(-1, True)
         req.done.set()
 
-    def _finish_timeout(self, req: Request) -> None:
-        """Complete a never-started request whose deadline already passed:
-        in-band timeout terminal event, no slot ever consumed."""
+    def _finish_timeout(self, req: Request, counted: bool = True) -> None:
+        """Complete an unslotted request whose deadline already passed:
+        in-band timeout terminal event, no slot consumed (``counted=False``
+        for preempted requests — already out of the admission count)."""
         with self._lock:
             self._requests.pop(req.id, None)
-            self._pending_n -= 1
+            if counted:
+                self._pending_n -= 1
         self._m_shed.inc(reason="timed_out")
         req.timed_out = True
         req.error = DeadlineExceeded(
@@ -1053,6 +1436,58 @@ class ServingEngine:
         self._observe_terminal(req, "timeout")
         if req.emit:
             req.emit(-1, True)
+        req.done.set()
+
+    def _pop_waiting(self) -> tuple[Request | None, bool, bool]:
+        """(next live request, came-from-resume, swept-any-dead-entries).
+
+        Preempted requests resume BEFORE anything in the pending queue;
+        dead entries (cancelled, already expired) are completed on the spot
+        so a burst of them never costs a free slot a step each."""
+        swept = False
+        while self._resume:
+            req = self._resume.popleft()
+            if req.cancelled:
+                self._finish_cancelled(req, counted=False)
+                swept = True
+            elif self._expired(req):
+                self._finish_timeout(req, counted=False)
+                swept = True
+            else:
+                return req, True, swept
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                return None, False, swept
+            if req.cancelled:
+                self._finish_cancelled(req)
+                swept = True
+            elif self._expired(req):
+                self._finish_timeout(req)
+                swept = True
+            else:
+                return req, False, swept
+
+    def _shed_kv_exhausted(self, req: Request, cause: Exception) -> None:
+        """Terminal shed for a request the allocator can never serve right
+        now (pool dry with nothing in flight to free it — including the
+        injected ``kv.alloc`` fault): RejectedError with Retry-After rides
+        req.error so HTTP front-ends answer 429, and the emit channel gets
+        its terminal event so nobody hangs."""
+        self._m_shed.inc(reason="kv_exhausted")
+        req.error = RejectedError(
+            f"KV page pool exhausted: {cause}",
+            retry_after_s=self.retry_after_s,
+        )
+        with self._lock:
+            self._requests.pop(req.id, None)
+        self._observe_terminal(req, "shed")
+        if req.emit:
+            try:
+                req.emit(-1, True)
+            except Exception:  # noqa: BLE001 — a bad sink must not kill the driver
+                pass
         req.done.set()
 
     def _free_slots(self) -> list[int]:
@@ -1077,32 +1512,34 @@ class ServingEngine:
         did_work = self._sweep_cancelled()
         prefills = []
         for slot in self._free_slots():
-            # Pop until a live request: a burst of queued-then-cancelled
-            # (client disconnects) or already-expired requests must not cost
-            # this free slot a step each.
-            req = None
-            while req is None:
-                try:
-                    req = self._pending.get_nowait()
-                except queue.Empty:
-                    break
-                if req.cancelled:
-                    self._finish_cancelled(req)
-                    did_work = True
-                    req = None
-                elif self._expired(req):
-                    self._finish_timeout(req)
-                    did_work = True
-                    req = None
+            req, resumed, swept = self._pop_waiting()
+            did_work = did_work or swept
             if req is None:
                 break
-            with self._lock:
-                self._pending_n -= 1   # leaving the queue for a slot
-            self._m_queue_wait.observe(time.monotonic() - req.submitted_at)
+            if not resumed:
+                with self._lock:
+                    self._pending_n -= 1   # leaving the queue for a slot
+                self._m_queue_wait.observe(
+                    time.monotonic() - req.submitted_at)
             if req.trace is not None:
                 req.trace.event("admitted")
             try:
                 prefills.append(self._dispatch_prefill(req, slot))
+            except PagePoolExhausted as e:
+                # No pages for this prompt right now. If anything is in
+                # flight, pages WILL free (requests finish, preemption,
+                # prefix eviction) — park the request at the FRONT so it
+                # retries next step ahead of everyone. If the engine is
+                # otherwise idle, nothing will ever free pages: shed with
+                # RejectedError + Retry-After rather than deadlocking.
+                req.requeued = True
+                if (self._active_requests() or prefills
+                        or self._inflight is not None):
+                    self._resume.appendleft(req)
+                else:
+                    self._shed_kv_exhausted(req, e)
+                did_work = True
+                break
             except Exception as e:
                 # The request is out of the queue but not yet slotted: fail
                 # it HERE or nobody ever wakes its waiter (_fail_all only
@@ -1168,6 +1605,187 @@ class ServingEngine:
         ):
             self._prefix_cache.popitem(last=False)
 
+    # --- paged prefix cache (shared refcounted pages, no tensor copies) ----
+
+    def _prefix_shared_pages(self) -> float:
+        """Distinct pool pages pinned by prefix entries (the scrape-time
+        kukeon_kv_prefix_shared_pages gauge)."""
+        if not self.paged:
+            return 0.0
+        pages: set[int] = set()
+        for e in self._prefix_cache.values():
+            pages.update(e.pages)
+        return float(len(pages))
+
+    def _prefix_lookup_paged(self, req: Request,
+                             seq: np.ndarray) -> "SharedPrefix | None":
+        """Usable stored prefix for ``seq``: its (page-aligned) tokens must
+        be a strict prefix — equal would leave nothing to prefill."""
+        if req.prefix_id is None:
+            return None
+        e = self._prefix_cache.get(req.prefix_id)
+        if (
+            e is not None
+            and e.length > 0
+            and seq.size > e.length
+            and np.array_equal(seq[: e.length], e.tokens)
+        ):
+            self._prefix_cache.move_to_end(req.prefix_id)
+            return e
+        return None
+
+    def _prefix_store_paged(self, prefix_id: str, seq: np.ndarray,
+                            pages: list[int]) -> None:
+        """(Re)point ``prefix_id`` at the slot's prompt pages — a refcount
+        bump, not a copy. Only FULL pages are shared: the trailing partial
+        page is about to receive the slot's decode writes, and sharing it
+        would let one session corrupt another's KV."""
+        if self._prefix_cache_size == 0 or self._prefix_cache_bytes == 0:
+            return
+        full = int(seq.size) // self.page_tokens
+        if full == 0:
+            return
+        entry_pages = list(pages[:full])
+        self._pool.ref(entry_pages)
+        old = self._prefix_cache.pop(prefix_id, None)
+        if old is not None:
+            self._pool.unref(old.pages)
+        self._prefix_cache[prefix_id] = SharedPrefix(
+            tokens=np.asarray(seq[: full * self.page_tokens]).copy(),
+            pages=entry_pages,
+            length=full * self.page_tokens,
+        )
+        while self._prefix_cache and (
+            len(self._prefix_cache) > self._prefix_cache_size
+            or sum(e.nbytes(self._page_bytes)
+                   for e in self._prefix_cache.values())
+            > self._prefix_cache_bytes
+        ):
+            _k, e = self._prefix_cache.popitem(last=False)
+            self._pool.unref(e.pages)
+
+    def _reclaim_prefix_pages(self, need: int) -> bool:
+        """Evict prefix entries LRU-first until ``need`` pages are free (or
+        nothing evictable remains); True when the pages materialized. Only
+        entries whose pages the cache alone holds are evicted: an entry
+        pinned by a live slot would free ZERO pages now (the slot's
+        references keep them resident) while losing the shared prefix for
+        every admission behind it — strictly worse than leaving it be."""
+        while self._pool.free < need and self._prefix_cache:
+            victim = None
+            for key, e in self._prefix_cache.items():       # LRU order
+                if all(self._pool.refcount(p) == 1 for p in e.pages):
+                    victim = key
+                    break
+            if victim is None:
+                break
+            e = self._prefix_cache.pop(victim)
+            self._pool.unref(e.pages)
+        return self._pool.free >= need
+
+    def _dispatch_prefill_paged(self, req: Request, slot: int):
+        """Paged admission: allocate the prompt's pages, prefill (suffix-
+        only over gathered shared pages on a prefix hit), scatter the block
+        into the pool by page index, and activate the slot.
+
+        A preempted request re-enters here with ``prompt + generated`` as
+        its sequence — its KV was reclaimed, so the whole context re-
+        prefills and generation continues where it stopped."""
+        faults.maybe_fail("engine.prefill")
+        t0 = time.monotonic()
+        seq = (req.prompt if not req.generated else
+               np.concatenate([req.prompt,
+                               np.asarray(req.generated, np.int32)]))
+        n = int(seq.size)
+        pt = self.page_tokens
+        sp = req.sampling
+        cached = self._prefix_lookup_paged(req, seq)
+        shared = list(cached.pages) if cached is not None else []
+        plen = cached.length if cached is not None else 0
+        n_total = n // pt + 1            # pages covering positions [0, n]
+        n_priv = n_total - len(shared)
+        try:
+            priv = self._pool.alloc(n_priv)
+        except PagePoolExhausted:
+            if not self._reclaim_prefix_pages(n_priv):
+                raise
+            # Eviction may have taken the entry we planned to share from;
+            # the refcounts we hold nothing of yet make a clean retry.
+            cached = self._prefix_lookup_paged(req, seq)
+            shared = list(cached.pages) if cached is not None else []
+            plen = cached.length if cached is not None else 0
+            n_priv = n_total - len(shared)
+            priv = self._pool.alloc(n_priv)
+        self._pool.ref(shared)           # the slot now also holds them
+        pages = shared + priv
+        with set_mesh(self.mesh):
+            self._key, k1 = jax.random.split(self._key)
+            if cached is not None:
+                self.prefix_hits += 1
+                # Gather the shared pages into the canonical prefix-bucket
+                # block the extension prefill speaks (scratch-padded ids
+                # keep one compile per bucket).
+                Pb = min(self._bucket(plen), self.max_seq_len)
+                gid = np.full((Pb // pt,), SCRATCH_PAGE, np.int32)
+                gid[: len(shared)] = shared
+                kv_k, kv_v = self._gather_block(
+                    self.state.cache.k, self.state.cache.v,
+                    self.state.cache.k_scale, self.state.cache.v_scale,
+                    self._upload(gid),
+                )
+                tail = seq[plen:]
+                bucket = min(self._bucket(tail.size), self.max_seq_len)
+                tokens = np.zeros((1, bucket), np.int32)
+                tokens[0, : tail.size] = tail
+                first, out_k, out_v = self._prefill_ext(
+                    self.params, kv_k, kv_v, plen,
+                    self._upload(tokens), tail.size, k1,
+                    jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                    jnp.float32(sp.top_p),
+                )
+            else:
+                if req.prefix_id is not None:
+                    self.prefix_misses += 1
+                bucket = min(self._bucket(n), self.max_seq_len)
+                tokens = np.zeros((1, bucket), np.int32)
+                tokens[0, :n] = seq
+                first, out_k, out_v = self._prefill(
+                    self.params, self._upload(tokens), n, k1,
+                    jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                    jnp.float32(sp.top_p),
+                )
+            # Scatter destinations for the block's pages: shared prefix
+            # pages and bucket padding redirect to scratch (shared pages
+            # are read-only; padding goes nowhere), private prompt pages
+            # land in their pool slots.
+            out_s = int(out_k.shape[2])
+            ids = np.full((out_s // pt,), SCRATCH_PAGE, np.int32)
+            prompt_pages = -(-n // pt)   # ceil: pages holding prompt rows
+            for i in range(len(shared), prompt_pages):
+                ids[i] = pages[i]
+            self.state = self._insert_paged(
+                self.state, out_k, out_v, n, self._upload(ids), slot, first)
+        self._slot_pages[slot] = pages
+        self._bt[slot, :] = SCRATCH_PAGE
+        self._bt[slot, : len(pages)] = pages
+        self._bt_dirty = True
+        self._slot_disp[slot] = n
+        if req.prefix_id is not None and cached is None:
+            # Store only on a miss: a hit entry is already serving this
+            # prefix_id, and re-pointing it at THIS session's page-aligned
+            # prompt would fold the session's private tail into the entry —
+            # poisoning the lookup for every sibling session whose prompt
+            # diverges after the genuinely shared part.
+            self._prefix_store_paged(req.prefix_id, seq, pages)
+        req.slot = slot
+        self._m_prefill.observe(time.monotonic() - t0, bucket=str(bucket))
+        if req.trace is not None:
+            req.trace.event("prefill_dispatched")
+        self._slot_req[slot] = req
+        self._slot_len[slot] = n + 1
+        self._sampling_dirty = True
+        return req, first
+
     def _dispatch_prefill(self, req: Request, slot: int):
         """Queue prefill+insert on device; returns (req, first-token device
         value) to fetch after other dispatches.
@@ -1176,6 +1794,8 @@ class ServingEngine:
         the model (an agent session's shared context prefills once); the
         resulting prompt KV is (re)stored under the request's prefix_id
         either way."""
+        if self.paged:
+            return self._dispatch_prefill_paged(req, slot)
         faults.maybe_fail("engine.prefill")
         t0 = time.monotonic()
         n = req.prompt.size
@@ -1229,8 +1849,13 @@ class ServingEngine:
         by the next insert, so the overshoot KV is never observed).
         """
         k = self.decode_chunk
-        # New requests should not wait for a long chunk to finish.
-        if not self._pending.empty():
+        # New requests should not wait for a long chunk to finish — but
+        # only when a free slot could actually seat one: with the batch
+        # full, the waiting request can't be admitted until someone
+        # finishes anyway, and short chunks would just multiply the
+        # per-chunk overhead (dispatch, and the paged layout's per-chunk
+        # gather/scatter) without buying any admission latency.
+        if (not self._pending.empty() or self._resume) and self._free_slots():
             k = min(k, 4)
         # Capacity must count the un-flushed inflight chunk: the device cache
         # is already k_inflight steps ahead of the host's _slot_len.
@@ -1259,15 +1884,133 @@ class ServingEngine:
             self._sampling_dirty = False
         return self._sampling_dev
 
-    def _dispatch_decode_chunk(self) -> _InflightChunk:
+    def _bt_dev_array(self):
+        """Device copy of the block table, re-uploaded only when a slot's
+        page list changed (insert/release/preempt/page growth) — the same
+        dirty-flag discipline as the sampling arrays, so steady-state decode
+        chunks perform no uploads at all."""
+        if self._bt_dev is None or self._bt_dirty:
+            self._bt_dev = self._upload(self._bt)
+            self._bt_dirty = False
+        return self._bt_dev
+
+    def _preempt_victim(self, exclude: int) -> int | None:
+        """Slot of the lowest-priority preemptable request: latest-submitted
+        wins the axe (oldest requests keep their progress), never the slot
+        we are allocating for."""
+        victim, latest = None, -1.0
+        for slot, req in self._active_requests():
+            if slot == exclude or req.done.is_set():
+                continue
+            if req.submitted_at >= latest:
+                victim, latest = slot, req.submitted_at
+        return victim
+
+    def _preempt_slot(self, slot: int, reason: str = "kv_pressure") -> None:
+        """Pause an in-flight request and reclaim its pages: the request
+        re-enters the queue AHEAD of new admissions and re-prefills
+        prompt+generated when pages free. The inflight chunk was flushed by
+        the caller, so every token already decoded for the victim has been
+        emitted — nothing is lost but the KV, which re-prefill rebuilds."""
+        req = self._slot_req[slot]
+        if req is None or req.done.is_set():
+            return
+        self._m_preempt.inc(reason=reason)
+        req.preemptions += 1
+        req.requeued = True
+        if req.trace is not None:
+            req.trace.event("preempted")
+        self._slot_req[slot] = None
+        self._sampling_dirty = True
+        self.state = DecodeState(
+            cache=self.state.cache,
+            tokens=self.state.tokens,
+            active=self.state.active.at[slot].set(False),
+        )
+        self._pool.unref(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self._slot_disp[slot] = 0
+        self._slot_len[slot] = 0
+        self._bt[slot, :] = SCRATCH_PAGE
+        self._bt_dirty = True
+        req.slot = -1
+        self._resume.append(req)
+        _LOG.debug("request %d preempted (%s), %d tokens so far",
+                   req.id, reason, len(req.generated),
+                   extra={"request_id": req.id, "phase": "preempted"})
+
+    def _ensure_decode_pages(self, k: int) -> None:
+        """Grow every active slot's block table to cover the next ``k``
+        decode steps, reclaiming under pressure in escalating order: flush
+        the inflight chunk (a finishing request frees its pages), evict
+        prefix-cache entries LRU-first, preempt the lowest-priority other
+        request, and — when one lone request simply cannot grow — finish it
+        at its current length rather than wedging the engine."""
+        for slot, req in self._active_requests():
+            # Pressure handling for an earlier slot may have preempted or
+            # finished this one mid-loop — skip anything no longer seated.
+            if self._slot_req[slot] is not req or req.done.is_set():
+                continue
+            # Plan k steps ahead, but never past the request's own final
+            # length (prompt + its max_new_tokens budget): rows an
+            # overshooting chunk writes beyond the block table's last page
+            # flat-map to scratch and are discarded with the overshoot
+            # tokens, so allocating real pages for them would only
+            # manufacture preemption pressure.
+            limit = min(self.max_seq_len,
+                        int(req.prompt.size) + req.sampling.max_new_tokens)
+            need = min(
+                self._pool.pages_for(min(self._slot_disp[slot] + k, limit)),
+                self.max_pages_per_slot)
+            while need > len(self._slot_pages[slot]):
+                delta = need - len(self._slot_pages[slot])
+                try:
+                    got = self._pool.alloc(delta)
+                except PagePoolExhausted:
+                    if self._inflight is not None:
+                        self._flush_inflight()
+                        self._inflight = None
+                        if req.done.is_set():
+                            break       # the flush finished this request
+                        continue        # retry: the flush may have freed pages
+                    if self._reclaim_prefix_pages(delta):
+                        continue
+                    victim = self._preempt_victim(exclude=slot)
+                    if victim is not None:
+                        self._preempt_slot(victim)
+                        continue
+                    # Last resort: nobody else to reclaim from — finish
+                    # this request at the tokens it already has.
+                    self._release_slot(req, exhausted=True)
+                    break
+                base = len(self._slot_pages[slot])
+                self._slot_pages[slot].extend(got)
+                self._bt[slot, base: base + len(got)] = got
+                self._bt_dirty = True
+
+    def _dispatch_decode_chunk(self) -> "_InflightChunk | None":
         faults.maybe_fail("engine.decode")
         k = self._chunk_size()
+        if self.paged:
+            self._ensure_decode_pages(k)
+            if not self._active_requests():
+                return None      # pressure handling drained the batch
         temps_d, top_ks_d, top_ps_d = self._sampling_dev_arrays()
         with set_mesh(self.mesh):
             self._key, k1 = jax.random.split(self._key)
-            self.state, toks = self._decode_chunk(
-                self.params, self.state, k1, temps_d, top_ks_d, top_ps_d, k,
-            )
+            if self.paged:
+                bt = self._bt_dev_array()
+                self.state, toks = self._decode_chunk_paged(
+                    self.params, self.state, bt, k1,
+                    temps_d, top_ks_d, top_ps_d, k,
+                )
+                for slot, _req in self._active_requests():
+                    self._slot_disp[slot] += k
+            else:
+                self.state, toks = self._decode_chunk(
+                    self.params, self.state, k1,
+                    temps_d, top_ks_d, top_ps_d, k,
+                )
         self.sync_stats["chunks"] += 1
         for _slot, req in self._active_requests():
             if req.trace is not None:
@@ -1323,7 +2066,7 @@ class ServingEngine:
             self._release_slot(req)
 
     def _release_slot(self, req: Request, cancelled: bool = False,
-                      timed_out: bool = False):
+                      timed_out: bool = False, exhausted: bool = False):
         slot = req.slot
         self._slot_req[slot] = None
         self._sampling_dirty = True
@@ -1332,14 +2075,25 @@ class ServingEngine:
             tokens=self.state.tokens,
             active=self.state.active.at[slot].set(False),
         )
+        if self.paged:
+            # Page-granular free: the slot's references drop; pages still
+            # pinned by a prefix entry (or a sibling session) stay resident,
+            # everything else returns to the pool. Zeroing the block-table
+            # row points any still-inflight decode write at scratch.
+            self._pool.unref(self._slot_pages[slot])
+            self._slot_pages[slot] = []
+            self._slot_disp[slot] = 0
+            self._bt[slot, :] = SCRATCH_PAGE
+            self._bt_dirty = True
         with self._lock:
             self._requests.pop(req.id, None)
         self._observe_terminal(
             req, "timeout" if timed_out else
             "cancelled" if cancelled else "ok")
-        if (cancelled or timed_out) and req.emit:
+        if (cancelled or timed_out or exhausted) and req.emit:
             # Streaming consumers need a terminal event on their channel;
-            # cancellation/expiry produces no token, so the sentinel is
-            # (-1, True) — the timeout itself travels on req.timed_out.
+            # cancellation/expiry (and a pool-exhausted early finish)
+            # produces no token, so the sentinel is (-1, True) — a timeout
+            # itself travels on req.timed_out.
             req.emit(-1, True)
         req.done.set()

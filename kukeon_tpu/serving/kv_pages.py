@@ -1,0 +1,163 @@
+"""Block-table KV page allocator: HBM as fixed-size pages, not slot slabs.
+
+The legacy engine layout reserves ``num_slots * max_seq_len`` KV rows up
+front — a slot serving a 40-token chat pins the same HBM as one serving a
+4k-token agent context, so mixed-length traffic fragments the cache and
+caps concurrency far below what the chip could hold. This module owns the
+host-side bookkeeping for the paged layout instead:
+
+- **Pages**: the engine's device pool is ``[L, P, page_tokens, KV, D]`` —
+  ``P`` fixed-size pages of ``page_tokens`` KV rows each, allocated and
+  freed page-granularly as requests are admitted, grow, and finish.
+- **Page 0 is scratch**: never allocated. Block-table entries of released
+  slots point at it (a stale in-flight decode write lands in scratch, not
+  in a page that was re-issued to another request), and insert-time
+  scatters redirect shared-prefix and padding pages to it so shared pages
+  are physically read-only.
+- **Refcounts**: a page may be held by the slot that wrote it AND by any
+  number of prefix-cache entries / later sessions reading it. ``alloc``
+  hands out pages at refcount 1; ``ref``/``unref`` move the count; a page
+  returns to the free list only at zero. N agent sessions on one shared
+  prefix therefore pay its KV cost once — the prefix entry pins the pages,
+  sessions add references, nobody copies.
+- **Exhaustion is a first-class outcome**: ``alloc`` raises
+  :class:`PagePoolExhausted` (and the ``kv.alloc`` fault point can inject
+  it) — the engine responds by evicting prefix entries, preempting the
+  lowest-priority in-flight request, or shedding, never by deadlocking.
+
+Import-light on purpose (numpy only): allocation decisions are host-side
+scheduler work; nothing here touches a device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+
+import numpy as np
+
+from kukeon_tpu import faults
+
+# The reserved scratch page: gather/scatter targets for "nowhere" — stale
+# writes from released slots, shared-prefix redirects, bucket padding.
+SCRATCH_PAGE = 0
+
+
+class PagePoolExhausted(RuntimeError):
+    """Not enough free KV pages to satisfy an allocation.
+
+    Recoverable by design: pages free as requests finish, prefix entries
+    evict, or a victim is preempted. The engine decides which; the
+    allocator only reports the fact."""
+
+
+def pages_for(n_tokens: int, page_tokens: int) -> int:
+    """Pages needed to hold ``n_tokens`` KV rows (ceil)."""
+    return -(-max(0, int(n_tokens)) // int(page_tokens))
+
+
+class PageAllocator:
+    """Free-list + refcount bookkeeping over ``num_pages`` usable pages.
+
+    Page ids run 1..num_pages (0 is :data:`SCRATCH_PAGE`, never issued).
+    The free list is FIFO so a just-freed page is re-issued as late as
+    possible — defense in depth under the double-buffered decode dispatch,
+    on top of the device-order argument that makes immediate reuse safe.
+
+    Driver-thread only (like every other piece of engine scheduling state);
+    no locking.
+    """
+
+    def __init__(self, num_pages: int, page_tokens: int):
+        if num_pages < 1:
+            raise ValueError(f"need at least 1 usable page, got {num_pages}")
+        if page_tokens < 1:
+            raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+        self.num_pages = int(num_pages)
+        self.page_tokens = int(page_tokens)
+        self._free: deque[int] = deque(range(1, self.num_pages + 1))
+        self._ref: dict[int, int] = {}
+
+    # --- introspection ----------------------------------------------------
+
+    @property
+    def free(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
+    def pages_for(self, n_tokens: int) -> int:
+        return pages_for(n_tokens, self.page_tokens)
+
+    # --- alloc / ref / free ----------------------------------------------
+
+    def alloc(self, n: int) -> list[int]:
+        """``n`` fresh pages at refcount 1, or :class:`PagePoolExhausted`.
+
+        All-or-nothing: a partial grant would leave the caller holding
+        pages it cannot use while blocking everyone else. The ``kv.alloc``
+        fault point injects exhaustion here so shedding/preemption paths
+        are testable without actually filling HBM."""
+        try:
+            faults.maybe_fail("kv.alloc")
+        except faults.FaultInjected as e:
+            raise PagePoolExhausted(str(e)) from e
+        if n <= 0:
+            return []
+        if n > len(self._free):
+            raise PagePoolExhausted(
+                f"need {n} KV pages, {len(self._free)}/{self.num_pages} free"
+            )
+        out = [self._free.popleft() for _ in range(n)]
+        for p in out:
+            self._ref[p] = 1
+        return out
+
+    def ref(self, pages) -> None:
+        """Add one reference to each page (a new reader of shared pages)."""
+        for p in pages:
+            if p == SCRATCH_PAGE:
+                continue
+            if p not in self._ref:
+                raise ValueError(f"ref of unallocated page {p}")
+            self._ref[p] += 1
+
+    def unref(self, pages) -> int:
+        """Drop one reference from each page; pages reaching zero return to
+        the free list. Returns how many were freed."""
+        freed = 0
+        for p in pages:
+            if p == SCRATCH_PAGE:
+                continue
+            c = self._ref.get(p)
+            if c is None:
+                raise ValueError(f"unref of unallocated page {p}")
+            if c <= 1:
+                del self._ref[p]
+                self._free.append(p)
+                freed += 1
+            else:
+                self._ref[p] = c - 1
+        return freed
+
+
+@dataclasses.dataclass
+class SharedPrefix:
+    """One prefix-cache entry in the paged layout: a *view* over pool pages,
+    not a tensor copy. ``pages`` hold one reference each (taken by the
+    engine at store time); ``length`` is page-aligned — the trailing
+    partial page of a prompt stays private to the slot that wrote it,
+    because decode writes the positions right after the prompt into that
+    page and sharing it would let one session corrupt another's KV."""
+
+    tokens: np.ndarray           # the aligned prefix the pages encode (int32)
+    pages: list[int]             # pool page ids, in sequence order
+    length: int                  # == len(pages) * page_tokens
+
+    def nbytes(self, page_bytes: int) -> int:
+        return len(self.pages) * page_bytes

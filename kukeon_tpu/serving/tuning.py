@@ -34,6 +34,12 @@ class ServingTune:
     kv_cache_int8: bool = False
     # None keeps the engine's default bucket ladder.
     prefill_buckets: tuple[int, ...] | None = None
+    # Paged KV cache page size (serving/kv_pages.py): None/0 keeps the
+    # legacy slot-contiguous layout; > 0 serves from a block-table page
+    # pool with pages of this many KV rows. A swept page size is an HBM/
+    # concurrency lever like the others — it must tile max_seq_len and the
+    # prefill buckets, which the engine validates at boot.
+    kv_page_tokens: int | None = None
     # Provenance (not consumed by the engine, kept for operators/debugging).
     tok_per_s: float | None = None
     tuned_at: str | None = None
@@ -45,6 +51,8 @@ class ServingTune:
         }
         if self.prefill_buckets:
             d["prefill_buckets"] = [int(b) for b in self.prefill_buckets]
+        if self.kv_page_tokens:
+            d["kv_page_tokens"] = int(self.kv_page_tokens)
         if self.tok_per_s is not None:
             d["tok_per_s"] = round(float(self.tok_per_s), 2)
         if self.tuned_at:
@@ -59,6 +67,8 @@ class ServingTune:
             kv_cache_int8=bool(d.get("kv_cache_int8", False)),
             prefill_buckets=(tuple(sorted({int(b) for b in buckets}))
                              if buckets else None),
+            kv_page_tokens=(int(d["kv_page_tokens"])
+                            if d.get("kv_page_tokens") else None),
             tok_per_s=(float(d["tok_per_s"])
                        if d.get("tok_per_s") is not None else None),
             tuned_at=d.get("tuned_at"),

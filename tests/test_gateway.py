@@ -496,9 +496,12 @@ def test_stream_through_gateway_from_real_cell_holds_back_split_utf8():
         _prefix_cache: dict = {}
         decode_chunk = 4
         kv_cache_int8 = False
+        page_tokens = 0
+        kv_pool_pages = 0
+        _pool = None
         tune = None
         max_pending = None
-        shed_stats = {"rejected": 0, "timed_out": 0}
+        shed_stats = {"rejected": 0, "timed_out": 0, "kv_exhausted": 0}
 
         def submit(self, prompt, sp, emit=None, prefix_id=None,
                    deadline_s=None):
@@ -926,25 +929,42 @@ def _load_bench():
     return mod
 
 
-def test_bench_artifact_v2_and_v1_backcompat(tmp_path):
+def test_bench_artifact_v3_and_backcompat(tmp_path):
     bench = _load_bench()
     serve = {"backend": "cpu", "n_chips": 1, "model": "tiny",
              "model_id": "tiny", "sessions": 4, "tok_per_s": 100.0,
-             "trials": [100.0], "replicas": 3}
+             "trials": [100.0], "replicas": 3,
+             "kv_page_tokens": 16, "max_sessions": 9}
     out = tmp_path / "BENCH_rXX.json"
     bench.write_artifact(str(out), serve, {"vs_baseline": 0.5})
     art = bench.read_artifact(str(out))
-    assert art["schema"] == "kukeon-bench/v2"
+    assert art["schema"] == "kukeon-bench/v3"
     assert art["replicas"] == 3
+    assert art["kv_page_tokens"] == 16
+    assert art["max_sessions"] == 9
 
-    # A v1 point (pre-gateway, single engine) reads back as v2/replicas=1.
+    # A v1 point (pre-gateway, single engine) reads back as v3: replicas=1,
+    # legacy contiguous KV (kv_page_tokens=0), every session resident.
     v1 = tmp_path / "BENCH_r05.json"
     v1.write_text(json.dumps({"schema": "kukeon-bench/v1", "backend": "cpu",
-                              "tok_per_s": 50.0}))
+                              "tok_per_s": 50.0, "sessions": 4}))
     art = bench.read_artifact(str(v1))
-    assert art["schema"] == "kukeon-bench/v2"
+    assert art["schema"] == "kukeon-bench/v3"
     assert art["replicas"] == 1
     assert art["tok_per_s"] == 50.0
+    assert art["kv_page_tokens"] == 0
+    assert art["max_sessions"] == 4
+
+    # A v2 point (pre-paged-KV) keeps its replicas and gains the v3 fields.
+    v2 = tmp_path / "BENCH_r06.json"
+    v2.write_text(json.dumps({"schema": "kukeon-bench/v2", "backend": "cpu",
+                              "tok_per_s": 60.0, "sessions": 2,
+                              "replicas": 2}))
+    art = bench.read_artifact(str(v2))
+    assert art["schema"] == "kukeon-bench/v3"
+    assert art["replicas"] == 2
+    assert art["kv_page_tokens"] == 0
+    assert art["max_sessions"] == 2
 
     bad = tmp_path / "BENCH_bad.json"
     bad.write_text(json.dumps({"schema": "nope/v9"}))

@@ -1,0 +1,325 @@
+"""Paged KV cache: allocator bookkeeping, paged-engine parity, refcounted
+prefix sharing, preemption/requeue ordering, and kv.alloc exhaustion
+shedding (ISSUE 6 tentpole + satellites)."""
+
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from kukeon_tpu import faults
+from kukeon_tpu.models import llama
+from kukeon_tpu.parallel import make_mesh
+from kukeon_tpu.serving import (
+    PageAllocator,
+    PagePoolExhausted,
+    RejectedError,
+    SamplingParams,
+    ServingEngine,
+)
+from kukeon_tpu.serving.kv_pages import SCRATCH_PAGE, pages_for
+
+
+# --- allocator bookkeeping ---------------------------------------------------
+
+
+class TestPageAllocator:
+    def test_alloc_free_roundtrip(self):
+        a = PageAllocator(8, 16)
+        assert a.free == 8 and a.in_use == 0
+        pages = a.alloc(3)
+        assert len(pages) == 3 and len(set(pages)) == 3
+        assert SCRATCH_PAGE not in pages          # page 0 is never issued
+        assert a.free == 5 and a.in_use == 3
+        assert all(a.refcount(p) == 1 for p in pages)
+        assert a.unref(pages) == 3
+        assert a.free == 8 and a.in_use == 0
+
+    def test_refcounted_sharing(self):
+        a = PageAllocator(4, 8)
+        pages = a.alloc(2)
+        a.ref(pages)                              # a second reader
+        assert all(a.refcount(p) == 2 for p in pages)
+        assert a.unref(pages) == 0                # first drop frees nothing
+        assert a.free == 2
+        assert a.unref(pages) == 2                # second drop frees both
+        assert a.free == 4
+
+    def test_exhaustion_is_all_or_nothing(self):
+        a = PageAllocator(4, 8)
+        a.alloc(3)
+        with pytest.raises(PagePoolExhausted):
+            a.alloc(2)
+        assert a.free == 1                        # nothing was handed out
+
+    def test_freed_pages_reissue_fifo(self):
+        """A just-freed page is re-issued as late as possible (defense in
+        depth under the double-buffered decode dispatch)."""
+        a = PageAllocator(3, 8)
+        first = a.alloc(2)
+        a.unref([first[0]])
+        # first[0] went to the BACK of the free list: the untouched page
+        # is issued before it.
+        assert a.alloc(1)[0] != first[0]
+
+    def test_ref_unref_unallocated_fail_loudly(self):
+        a = PageAllocator(2, 8)
+        with pytest.raises(ValueError):
+            a.ref([1])
+        with pytest.raises(ValueError):
+            a.unref([2])
+        # Scratch is silently skipped (block tables are padded with it).
+        a.ref([SCRATCH_PAGE])
+        a.unref([SCRATCH_PAGE])
+
+    def test_pages_for(self):
+        assert pages_for(0, 16) == 0
+        assert pages_for(1, 16) == 1
+        assert pages_for(16, 16) == 1
+        assert pages_for(17, 16) == 2
+        assert PageAllocator(4, 16).pages_for(33) == 3
+
+
+# --- paged engine ------------------------------------------------------------
+
+
+def _make(cfg=None, **kw):
+    cfg = cfg or llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("decode_chunk", 4)
+    return ServingEngine(cfg, params, mesh, **kw), cfg, params
+
+
+def test_paged_greedy_matches_legacy():
+    """The paged gather/scatter programs are a pure layout change: greedy
+    output is token-identical to the legacy contiguous engine."""
+    eng_p, cfg, params = _make(kv_page_tokens=16, kv_pool_pages=16)
+    mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+    eng_l = ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=128,
+                          decode_chunk=4)
+    prompt = np.arange(1, 20, dtype=np.int32)
+    sp = SamplingParams(max_new_tokens=8, temperature=0.0)
+    assert eng_p.generate(prompt, sp) == eng_l.generate(prompt, sp)
+    # Pages free page-granularly as the request finishes.
+    assert eng_p._pool.in_use == 0
+
+
+def test_paged_page_size_must_tile():
+    cfg = llama.llama_tiny()
+    with pytest.raises(ValueError, match="max_seq_len"):
+        _make(cfg, kv_page_tokens=48)             # 128 % 48 != 0
+    with pytest.raises(ValueError, match="bucket"):
+        _make(cfg, kv_page_tokens=32, prefill_buckets=(48, 128))
+
+
+def test_paged_overlong_prompt_fails_at_submit():
+    eng, *_ = _make(kv_page_tokens=16, kv_pool_pages=4)
+    with pytest.raises(ValueError, match="pool"):
+        eng.submit(np.ones((100,), np.int32))     # needs 7 pages, pool holds 4
+
+
+def test_prefix_pages_shared_not_copied():
+    """N sessions on one agent prefix pay its KV cost once: the second
+    session references the stored pages (refcount), gathers them for a
+    suffix-only prefill, and produces the same tokens a cold prefill
+    would."""
+    eng, cfg, params = _make(num_slots=4, kv_page_tokens=16,
+                             kv_pool_pages=32)
+    prefix = np.arange(1, 65, dtype=np.int32)     # 4 full pages
+    sp = SamplingParams(max_new_tokens=4, temperature=0.0)
+
+    r1 = eng.submit(np.concatenate([prefix, np.array([70, 71], np.int32)]),
+                    sp, prefix_id="agent")
+    while not r1.done.is_set():
+        eng.step()
+    assert eng.prefix_misses == 1
+    entry = eng._prefix_cache["agent"]
+    assert entry.length == 64 and len(entry.pages) == 4
+    # The finished request released its references; the cache entry alone
+    # pins the shared pages now.
+    assert all(eng._pool.refcount(p) == 1 for p in entry.pages)
+    assert eng._prefix_shared_pages() == 4.0
+
+    r2 = eng.submit(np.concatenate([prefix, np.array([80, 81], np.int32)]),
+                    sp, prefix_id="agent")
+    while not r2.done.is_set():
+        eng.step()
+    assert eng.prefix_hits == 1
+
+    # Cold-engine reference: same prompt, no prefix cache.
+    eng2, *_ = _make(num_slots=4, kv_page_tokens=16, kv_pool_pages=32)
+    assert r2.generated == eng2.generate(
+        np.concatenate([prefix, np.array([80, 81], np.int32)]), sp)
+
+    # A hit must NOT re-point the entry at the hitting session's prompt
+    # (that would fold its private tail into the shared entry).
+    assert eng._prefix_cache["agent"].length == 64
+
+
+def test_preemption_under_pressure_completes_everything():
+    """A pool too small for every in-flight context forces preemption; all
+    requests still finish with their full token budget, the preemption
+    counter moves, the victim's trace records a ``preempted`` phase, and
+    the pool drains to zero."""
+    eng, *_ = _make(num_slots=3, kv_page_tokens=16, kv_pool_pages=8,
+                    prefix_cache_size=0)
+    sp = SamplingParams(max_new_tokens=40, temperature=0.8)
+    reqs = [eng.submit(np.arange(1, 40, dtype=np.int32), sp)
+            for _ in range(3)]
+    n = 0
+    while not all(r.done.is_set() for r in reqs) and n < 800:
+        eng.step()
+        n += 1
+    assert all(r.done.is_set() for r in reqs)
+    assert all(r.error is None for r in reqs)
+    assert all(len(r.generated) == 40 for r in reqs)
+    assert int(eng._m_preempt.value(reason="kv_pressure")) >= 1
+    victims = [r for r in reqs if r.preemptions > 0]
+    assert victims
+    for r in victims:
+        assert "preempted" in [name for name, _t in r.trace.events]
+    assert eng._pool.in_use == 0
+
+
+def test_preempted_request_resumes_before_new_admissions():
+    """Requeue ordering (ISSUE 6 satellite): a preempted request re-enters
+    the queue AHEAD of requests that were admitted after it."""
+    eng, *_ = _make(num_slots=2, kv_page_tokens=16, kv_pool_pages=6,
+                    prefill_buckets=(64,), prefix_cache_size=0)
+    sp = SamplingParams(max_new_tokens=48, temperature=0.5)
+    # Two long-growing requests: their combined final footprint (2 * 4+
+    # pages) overflows the 6-page pool, so the later-submitted one is
+    # preempted when the first grows.
+    a = eng.submit(np.arange(1, 33, dtype=np.int32), sp)
+    b = eng.submit(np.arange(1, 33, dtype=np.int32), sp)
+    while not b.preemptions and not (a.done.is_set() and b.done.is_set()):
+        eng.step()
+    assert b.preemptions >= 1 and not b.done.is_set()
+    assert b in eng._resume
+
+    # A newcomer admitted while b waits for pages must not overtake it.
+    c = eng.submit(np.arange(1, 9, dtype=np.int32),
+                   SamplingParams(max_new_tokens=4))
+    while not b.done.is_set():
+        eng.step()
+        if eng._slot_req.count(None) < 2 and c.slot >= 0:
+            # c got a slot while b still waits -> ordering violated...
+            assert b.slot >= 0 or b.done.is_set(), (
+                "newly admitted request seated before the preempted one")
+    while not c.done.is_set():
+        eng.step()
+    assert b.error is None and len(b.generated) == 48
+    assert c.error is None
+
+
+def test_preempted_request_respects_deadline_while_parked():
+    """A preempted request parked for resume still observes its deadline:
+    expiry produces the in-band timeout terminal, not a silent hang."""
+    eng, *_ = _make(num_slots=2, kv_page_tokens=16, kv_pool_pages=6,
+                    prefill_buckets=(64,), prefix_cache_size=0)
+    sp = SamplingParams(max_new_tokens=48, temperature=0.5)
+    a = eng.submit(np.arange(1, 33, dtype=np.int32), sp)
+    b = eng.submit(np.arange(1, 33, dtype=np.int32), sp,
+                   deadline_s=30.0)
+    while not b.preemptions and not (a.done.is_set() and b.done.is_set()):
+        eng.step()
+    assert b.preemptions >= 1 and not b.done.is_set()
+    b.deadline = time.monotonic() - 0.001          # expire it in the park
+    while not b.done.is_set():
+        eng.step()
+    assert b.timed_out
+    assert isinstance(b.error, Exception)
+    # a continues unharmed.
+    while not a.done.is_set():
+        eng.step()
+    assert a.error is None and len(a.generated) == 48
+
+
+# --- kv.alloc fault point ----------------------------------------------------
+
+
+@pytest.mark.faults
+def test_kv_alloc_exhaustion_sheds_never_deadlocks():
+    """Injected allocator exhaustion (fault point kv.alloc) on an idle
+    engine: nothing will ever free pages, so the request sheds with
+    RejectedError + Retry-After — the emit channel gets its terminal
+    event and nobody hangs."""
+    eng, *_ = _make(kv_page_tokens=16, kv_pool_pages=16)
+    os.environ[faults.ENV] = "kv.alloc:1"
+    events = []
+    req = eng.submit(np.arange(1, 9, dtype=np.int32),
+                     SamplingParams(max_new_tokens=4),
+                     emit=lambda t, d: events.append((t, d)))
+    done = req.done.wait(0.01)
+    assert not done
+    for _ in range(10):
+        eng.step()
+        if req.done.is_set():
+            break
+    assert req.done.is_set()
+    assert isinstance(req.error, RejectedError)
+    assert req.error.retry_after_s > 0
+    assert events[-1] == (-1, True)
+    assert eng.shed_stats["kv_exhausted"] == 1
+
+    # Disarm: the engine keeps serving normally afterwards.
+    os.environ.pop(faults.ENV, None)
+    faults.reset()
+    out = eng.generate(np.arange(1, 9, dtype=np.int32),
+                       SamplingParams(max_new_tokens=4, temperature=0.0))
+    assert len(out) == 4
+
+
+@pytest.mark.faults
+def test_kv_alloc_exhaustion_with_inflight_work_retries():
+    """With other work in flight, injected exhaustion parks the request
+    for retry instead of shedding — pages WILL free when the in-flight
+    request finishes, and the parked one then completes."""
+    eng, *_ = _make(kv_page_tokens=16, kv_pool_pages=16)
+    sp = SamplingParams(max_new_tokens=12, temperature=0.0)
+    a = eng.submit(np.arange(1, 9, dtype=np.int32), sp)
+    eng.step()                                     # a is slotted + decoding
+    os.environ[faults.ENV] = "kv.alloc:1:1"        # fail exactly one alloc
+    b = eng.submit(np.arange(1, 9, dtype=np.int32), sp)
+    for _ in range(200):
+        eng.step()
+        if a.done.is_set() and b.done.is_set():
+            break
+    assert a.error is None and b.error is None
+    assert len(a.generated) == 12 and len(b.generated) == 12
+
+
+# --- engine-loop recovery ----------------------------------------------------
+
+
+def test_paged_engine_loop_recovers_with_fresh_pool():
+    """After an engine-loop failure the rebuilt state gets a fresh pool:
+    every page, block table, and prefix entry of the poisoned pool is
+    discarded, and serving continues."""
+    eng, *_ = _make(kv_page_tokens=16, kv_pool_pages=16)
+    sp = SamplingParams(max_new_tokens=4, temperature=0.0)
+    want = eng.generate(np.arange(1, 9, dtype=np.int32), sp)
+
+    eng.start()
+    try:
+        os.environ[faults.ENV] = "engine.decode:1:1"
+        req = eng.submit(np.arange(1, 9, dtype=np.int32), sp)
+        assert req.done.wait(20)
+        assert req.error is not None
+        os.environ.pop(faults.ENV, None)
+        faults.reset()
+        req2 = eng.submit(np.arange(1, 9, dtype=np.int32), sp)
+        assert req2.done.wait(30)
+        assert req2.error is None and req2.generated == want
+        assert eng._pool.in_use == 0
+        assert not eng._prefix_cache
+    finally:
+        os.environ.pop(faults.ENV, None)
+        faults.reset()
+        eng.stop()

@@ -94,6 +94,30 @@ def test_decode_compile_counter_flat_across_slot_churn():
     assert {"prefill", "insert", "decode"} <= programs
 
 
+def test_decode_compile_counter_flat_across_slot_churn_paged():
+    """The same no-recompile invariant on the paged path (ISSUE 6): the
+    block table is a static-shape [B, max_pages] array, so slot churn AND
+    page churn (alloc/free/growth across requests of different lengths)
+    must not move kukeon_compiles_total{program="decode"}."""
+    eng = _tiny_engine(kv_page_tokens=16, kv_pool_pages=12)
+    eng.warmup(8)
+    base = eng.compiles.count("decode")
+    assert base >= 1
+
+    r1 = eng.submit(PROMPT, SamplingParams(max_new_tokens=12))
+    eng.step()
+    r2 = eng.submit(PROMPT[:4], SamplingParams(max_new_tokens=3))
+    while not r2.done.is_set():
+        eng.step()
+    r3 = eng.submit(PROMPT, SamplingParams(max_new_tokens=2))
+    while not (r1.done.is_set() and r3.done.is_set()):
+        eng.step()
+    assert eng.compiles.count("decode") == base, (
+        "paged decode recompiled during slot/page churn")
+    # The pool drained page-granularly as requests finished.
+    assert eng._pool.in_use == 0
+
+
 def test_compile_tracker_counts_new_shapes():
     """A genuinely new shape (an unseen prefill bucket) IS counted — the
     tracker distinguishes real compiles from steady state, not just

@@ -120,6 +120,7 @@ def test_serving_cell_boots_from_profile(tune_path, monkeypatch):
                        checkpoint=None, dtype=None)
     assert cell.engine.decode_chunk == 4
     t = cell.stats()["tuning"]
-    assert t == {"decodeChunk": 4, "kvCacheInt8": False, "fromProfile": True}
+    assert t == {"decodeChunk": 4, "kvCacheInt8": False, "kvPageTokens": 0,
+                 "fromProfile": True}
     out = cell.generate({"prompt": "hello", "maxNewTokens": 4})
     assert out["numTokens"] == 4
