@@ -1,0 +1,49 @@
+"""kukelint — the in-tree static analyzer that enforces the runtime's own
+invariants (host-sync discipline, jit stability, lock discipline, the
+fault-point and metric registries) as lint errors with stable rule ids.
+
+Run it::
+
+    python -m kukeon_tpu.analysis            # whole package, baseline applied
+    python -m kukeon_tpu.analysis --select KUKE005,KUKE006
+    python -m kukeon_tpu.analysis --update-baseline
+
+Rules:
+
+======== =====================================================================
+KUKE001  device→host transfer in an engine hot-path method outside ``_fetch``
+KUKE002  host→device upload in an engine hot-path method outside ``_upload``
+KUKE003  Python container literal in a traced position of a jitted program
+KUKE004  jitted program closes over mutable engine state
+KUKE005  attribute written under a lock somewhere, written unlocked elsewhere
+KUKE006  lock acquisition-order cycle (potential deadlock)
+KUKE007  fault point not declared in faults.POINTS (or stale declaration)
+KUKE008  ``kukeon_*`` metric family missing from the README reference table
+======== =====================================================================
+
+Zero-dependency by design (stdlib ``ast`` only): importable and runnable
+without jax, so it can gate commits anywhere the repo checks out. The
+checked-in baseline (``analysis/baseline.json``) suppresses accepted
+pre-existing findings — a new violation fails the run and the tier-1
+test in tests/test_static_analysis.py.
+"""
+
+from kukeon_tpu.analysis.core import (
+    Baseline,
+    BaselineEntry,
+    Finding,
+    default_baseline_path,
+    load_sources,
+    registered_rules,
+    run_analysis,
+)
+
+__all__ = [
+    "Baseline",
+    "BaselineEntry",
+    "Finding",
+    "default_baseline_path",
+    "load_sources",
+    "registered_rules",
+    "run_analysis",
+]

@@ -1,0 +1,97 @@
+"""CLI: ``python -m kukeon_tpu.analysis [options] [package_root]``.
+
+Exit codes: 0 = clean (all findings baseline-suppressed), 1 = new
+findings, 2 = bad usage. Stale baseline entries are reported but do not
+fail the run (they fail ``--strict-baseline``, which tools/check.sh and
+the tier-1 self-check use so the baseline cannot rot).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from kukeon_tpu.analysis.core import (
+    Baseline, BaselineEntry, default_baseline_path, registered_rules,
+    run_analysis,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kukeon_tpu.analysis",
+        description="kukelint: enforce the runtime's own invariants",
+    )
+    parser.add_argument(
+        "package_root", nargs="?",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="package directory to analyze (default: the installed "
+             "kukeon_tpu package)")
+    parser.add_argument(
+        "--select", default=None, metavar="RULES",
+        help="comma-separated rule ids to run (default: all)")
+    parser.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help="baseline file (default: kukeon_tpu/analysis/baseline.json)")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, suppressing nothing")
+    parser.add_argument(
+        "--strict-baseline", action="store_true",
+        help="also fail on stale baseline entries (pre-PR gate mode)")
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from the current findings, keeping "
+             "existing justifications; new entries get a TODO marker")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the registered rule ids and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in registered_rules():
+            print(rule)
+        return 0
+
+    select = args.select.split(",") if args.select else None
+    try:
+        findings = run_analysis(args.package_root, select=select)
+    except (OSError, SyntaxError) as e:
+        print(f"kukelint: cannot analyze {args.package_root}: {e}",
+              file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = (Baseline() if args.no_baseline
+                else Baseline.load(baseline_path))
+
+    if args.update_baseline:
+        kept = {e.fingerprint: e for e in baseline.entries}
+        baseline.entries = [
+            kept.get(f.fingerprint,
+                     BaselineEntry(f.fingerprint, "TODO: justify"))
+            for f in {f.fingerprint: f for f in findings}.values()
+        ]
+        baseline.save(baseline_path)
+        print(f"kukelint: baseline rewritten with "
+              f"{len(baseline.entries)} suppression(s) at {baseline_path}")
+        return 0
+
+    new, suppressed, stale = baseline.apply(findings)
+    for f in new:
+        print(f.render())
+    for e in stale:
+        print(f"kukelint: stale baseline entry (matches nothing): "
+              f"{e.fingerprint}")
+    print(f"kukelint: {len(new)} finding(s), {len(suppressed)} suppressed "
+          f"by baseline, {len(stale)} stale baseline entr(ies)")
+    if new:
+        return 1
+    if stale and args.strict_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

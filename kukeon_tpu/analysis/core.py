@@ -1,0 +1,212 @@
+"""kukelint core: findings, baseline suppression, file loading, pass registry.
+
+The analyzer is a zero-dependency ``ast``-module tool: every pass receives
+the parsed module trees and returns :class:`Finding` objects. Nothing here
+imports jax (or anything else heavy) — ``python -m kukeon_tpu.analysis``
+must be runnable in a bare interpreter and cheap enough for a pre-commit
+gate.
+
+Baselines: a finding's identity for suppression purposes is its
+:meth:`Finding.fingerprint` — rule + file + enclosing scope + a
+rule-chosen detail key, deliberately WITHOUT the line number, so editing
+an unrelated part of a file does not orphan the suppression. The checked-in
+baseline (``kukeon_tpu/analysis/baseline.json``) lists accepted
+pre-existing findings with a one-line justification each; anything not in
+it fails the run, and baseline entries matching nothing are reported as
+stale so they get cleaned up rather than rotting.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Callable, Iterable, Sequence
+
+BASELINE_FILENAME = "baseline.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str          # stable rule id, e.g. "KUKE001"
+    file: str          # path relative to the repo root (posix separators)
+    line: int
+    message: str       # human sentence, shown with file:line
+    scope: str = ""    # enclosing qualname (Class.method) — part of identity
+    detail: str = ""   # rule-chosen stable key (attr name, point name, ...)
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-independent identity used by baseline suppression."""
+        return f"{self.rule}:{self.file}:{self.scope}:{self.detail}"
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclasses.dataclass
+class SourceFile:
+    """A parsed package module handed to every pass."""
+
+    path: str          # absolute
+    rel: str           # relative to the repo root, posix separators
+    tree: ast.Module
+    text: str
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    fingerprint: str
+    justification: str
+
+
+class Baseline:
+    """Accepted pre-existing findings; everything else is a failure."""
+
+    def __init__(self, entries: Sequence[BaselineEntry] = ()):
+        self.entries = list(entries)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        entries = [
+            BaselineEntry(e["fingerprint"], e.get("justification", ""))
+            for e in data.get("suppressions", ())
+        ]
+        return cls(entries)
+
+    def save(self, path: str) -> None:
+        data = {
+            "version": 1,
+            "suppressions": [
+                {"fingerprint": e.fingerprint,
+                 "justification": e.justification}
+                for e in sorted(self.entries, key=lambda e: e.fingerprint)
+            ],
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2)
+            f.write("\n")
+
+    def apply(self, findings: Iterable[Finding]) -> tuple[
+            list[Finding], list[Finding], list[BaselineEntry]]:
+        """(new, suppressed, stale-entries) split of ``findings``."""
+        by_fp: dict[str, BaselineEntry] = {
+            e.fingerprint: e for e in self.entries}
+        new: list[Finding] = []
+        suppressed: list[Finding] = []
+        matched: set[str] = set()
+        for f in findings:
+            if f.fingerprint in by_fp:
+                suppressed.append(f)
+                matched.add(f.fingerprint)
+            else:
+                new.append(f)
+        stale = [e for e in self.entries if e.fingerprint not in matched]
+        return new, suppressed, stale
+
+
+def load_sources(package_root: str) -> list[SourceFile]:
+    """Parse every ``*.py`` under ``package_root`` (skipping caches)."""
+    repo_root = os.path.dirname(os.path.abspath(package_root))
+    out: list[SourceFile] = []
+    for dirpath, dirnames, filenames in os.walk(package_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+            out.append(SourceFile(
+                path=path, rel=rel, tree=ast.parse(text, filename=path),
+                text=text,
+            ))
+    return out
+
+
+# A pass: (sources, package_root) -> findings. Registered with the rule ids
+# it can emit so --select can skip whole passes.
+Pass = Callable[[Sequence[SourceFile], str], list[Finding]]
+
+_PASSES: list[tuple[tuple[str, ...], Pass]] = []
+
+
+def register_pass(rule_ids: tuple[str, ...]) -> Callable[[Pass], Pass]:
+    def deco(fn: Pass) -> Pass:
+        _PASSES.append((rule_ids, fn))
+        return fn
+    return deco
+
+
+def _ensure_passes_loaded() -> None:
+    # Import the passes for their registration side effect; deferred so
+    # core stays importable without the pass modules (fixture tests).
+    from kukeon_tpu.analysis import (  # noqa: F401
+        hostsync, jitstability, locks, registries,
+    )
+
+
+def registered_rules() -> tuple[str, ...]:
+    _ensure_passes_loaded()
+    out: list[str] = []
+    for ids, _fn in _PASSES:
+        out.extend(ids)
+    return tuple(sorted(out))
+
+
+def run_analysis(package_root: str,
+                 select: Sequence[str] | None = None) -> list[Finding]:
+    """Run every registered pass (or the ``select``-ed rule ids) over the
+    package; findings come back sorted by file, line, rule."""
+    _ensure_passes_loaded()
+    sources = load_sources(package_root)
+    wanted = set(select) if select else None
+    findings: list[Finding] = []
+    for rule_ids, fn in _PASSES:
+        if wanted is not None and not (wanted & set(rule_ids)):
+            continue
+        got = fn(sources, package_root)
+        if wanted is not None:
+            got = [f for f in got if f.rule in wanted]
+        findings.extend(got)
+    return sorted(findings, key=lambda f: (f.file, f.line, f.rule, f.detail))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        BASELINE_FILENAME)
+
+
+# --- small shared AST helpers -------------------------------------------------
+
+
+def qualname(stack: Sequence[ast.AST]) -> str:
+    """Dotted Class.method name from an enclosing-scope stack."""
+    parts = [n.name for n in stack
+             if isinstance(n, (ast.ClassDef, ast.FunctionDef,
+                               ast.AsyncFunctionDef))]
+    return ".".join(parts)
+
+
+def is_self_attr(node: ast.AST, attr: str | None = None) -> bool:
+    """``self.X`` (any X, or a specific one)."""
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+        and (attr is None or node.attr == attr)
+    )
+
+
+def const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -1,0 +1,397 @@
+"""KUKE005/KUKE006 — lock discipline across the threaded modules.
+
+The runtime is full of small, single-purpose locks (engine admission,
+cell lifecycle/stats, registry, tracer, runner per-cell locks…). Two
+properties keep them honest, both checkable from the AST:
+
+- **KUKE005 — consistent guarding.** Per class: an attribute that is
+  written under ``self.<lock>`` *anywhere* must never be written outside
+  it. Half-guarded state is the classic latent race — the locked site
+  documents the intent, the unlocked one silently breaks it. Constructor
+  writes (``__init__``/``__post_init__``/``_init*`` helpers) are exempt:
+  the object is not shared yet. A private method whose every intra-class
+  call site sits inside a region of the same lock is treated as running
+  under that lock (one level of call-mediated context, computed to a
+  fixed point), so ``call()``-holds-the-lock-then-calls-``_ensure_conn``
+  patterns do not false-positive.
+- **KUKE006 — acquisition-order cycles.** A directed graph over every
+  lock in the package: edge A→B when code acquires B while holding A,
+  either lexically (nested ``with``) or through a call made inside A's
+  region that resolves to a method acquiring B (resolution: same-class
+  ``self.m()``; ``self.attr.m()`` where ``self.attr`` is assigned a
+  constructor of a package class — imports followed one re-export hop).
+  Any cycle is a potential deadlock and is reported once per cycle with
+  the participating edges. Resolution is deliberately under-approximate
+  (unknown callees add no edge): a reported cycle is real evidence, not
+  name-collision noise.
+
+Lock identification: an attribute assigned ``threading.Lock()`` /
+``RLock()`` (instance or class level), a module-level name so assigned,
+or — for classes that receive a lock by injection — any ``with self.X:``
+where ``X`` contains ``lock`` or ``mu`` (the obs registry hands its lock
+to the metrics it creates; the convention is load-bearing and cheap to
+honor).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Sequence
+
+from kukeon_tpu.analysis.core import (
+    Finding, SourceFile, is_self_attr, register_pass,
+)
+
+INIT_EXEMPT_PREFIXES = ("__init__", "__post_init__", "_init")
+
+_LOCKY = ("lock", "mu", "mutex")
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    """``threading.Lock()`` / ``threading.RLock()`` / bare ``Lock()``."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    return name in ("Lock", "RLock")
+
+
+def _locky_name(name: str) -> bool:
+    low = name.lower()
+    return any(t in low for t in _LOCKY)
+
+
+@dataclasses.dataclass
+class _Write:
+    attr: str
+    method: str
+    line: int
+    locks: frozenset[str]     # lock names held lexically at the write
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    module: str               # rel path of the defining file
+    name: str
+    node: ast.ClassDef
+    lock_attrs: set[str] = dataclasses.field(default_factory=set)
+    writes: list[_Write] = dataclasses.field(default_factory=list)
+    # method -> [(locks-held-at-call, callee-expr)]
+    calls: dict[str, list[tuple[frozenset, ast.Call]]] = (
+        dataclasses.field(default_factory=dict))
+    # method -> locks it acquires anywhere in its body
+    acquires: dict[str, set[str]] = dataclasses.field(default_factory=dict)
+    # self.attr -> class name assigned via ``self.attr = ClassName(...)``
+    attr_types: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def lock_id(self, lock_name: str) -> str:
+        return f"{self.module}:{self.name}.{lock_name}"
+
+
+def _with_lock_items(node: ast.With, cls: "_ClassInfo | None",
+                     module_locks: set[str]) -> list[str]:
+    """Names of locks acquired by this ``with`` (empty for non-lock withs)."""
+    out: list[str] = []
+    for item in node.items:
+        ctx = item.context_expr
+        if is_self_attr(ctx):
+            if cls is not None and (ctx.attr in cls.lock_attrs
+                                    or _locky_name(ctx.attr)):
+                if cls is not None:
+                    cls.lock_attrs.add(ctx.attr)
+                out.append(ctx.attr)
+        elif isinstance(ctx, ast.Name) and ctx.id in module_locks:
+            out.append(f"<module>:{ctx.id}")
+    return out
+
+
+def _scan_function(fn: ast.FunctionDef, cls: _ClassInfo | None,
+                   module_locks: set[str]) -> None:
+    """Record writes, lock regions, and in-region calls for one function."""
+    acquires: set[str] = set()
+
+    def visit(node: ast.AST, held: frozenset) -> None:
+        if isinstance(node, ast.With):
+            got = _with_lock_items(node, cls, module_locks)
+            acquires.update(got)
+            inner = frozenset(held | set(got))
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return   # nested defs run later, under unknown locks
+        if cls is not None:
+            target_attrs: list[tuple[str, int]] = []
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    target_attrs.extend(_attr_writes(t))
+                # Track ``self.attr = ClassName(...)`` for call resolution.
+                if (len(node.targets) == 1
+                        and is_self_attr(node.targets[0])
+                        and isinstance(node.value, ast.Call)):
+                    c = _ctor_name(node.value)
+                    if c:
+                        cls.attr_types[node.targets[0].attr] = c
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                target_attrs.extend(_attr_writes(node.target))
+            for attr, line in target_attrs:
+                cls.writes.append(_Write(attr, fn.name, line, held))
+            if isinstance(node, ast.Call):
+                cls.calls.setdefault(fn.name, []).append((held, node))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fn.body:
+        visit(stmt, frozenset())
+    if cls is not None:
+        cls.acquires.setdefault(fn.name, set()).update(acquires)
+
+
+def _attr_writes(target: ast.AST) -> list[tuple[str, int]]:
+    """self-attribute names written by an assignment target, including
+    through a subscript (``self.x[k] = v`` mutates ``self.x``)."""
+    out: list[tuple[str, int]] = []
+    if is_self_attr(target):
+        out.append((target.attr, target.lineno))
+    elif isinstance(target, ast.Subscript) and is_self_attr(target.value):
+        out.append((target.value.attr, target.lineno))
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            out.extend(_attr_writes(elt))
+    return out
+
+
+def _ctor_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _collect_class(src: SourceFile, node: ast.ClassDef,
+                   module_locks: set[str]) -> _ClassInfo:
+    info = _ClassInfo(module=src.rel, name=node.name, node=node)
+    # Pre-pass: find declared lock attributes (instance + class level).
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Assign) and _is_lock_ctor(sub.value):
+            for t in sub.targets:
+                if is_self_attr(t):
+                    info.lock_attrs.add(t.attr)
+                elif isinstance(t, ast.Name):
+                    info.lock_attrs.add(t.id)     # class-level lock
+    for meth in node.body:
+        if isinstance(meth, ast.FunctionDef):
+            _scan_function(meth, info, module_locks)
+    return info
+
+
+def _locked_context_methods(info: _ClassInfo) -> dict[str, frozenset]:
+    """Private methods that only ever run with a known lock held: every
+    intra-class ``self.m()`` call site is inside a region of the same
+    lock(s). Fixed point so chains (A locks, calls _b, _b calls _c)
+    resolve."""
+    # method -> set of (held) frozensets at each intra-class call site
+    sites: dict[str, list[frozenset]] = {}
+    for caller, calls in info.calls.items():
+        for held, call in calls:
+            f = call.func
+            if is_self_attr(f) and f.attr != caller:
+                sites.setdefault(f.attr, []).append(held)
+    ctx: dict[str, frozenset] = {}
+    for _ in range(len(sites) + 1):
+        changed = False
+        for meth, helds in sites.items():
+            if not meth.startswith("_") or meth.startswith("__"):
+                continue
+            eff = []
+            for caller, calls in info.calls.items():
+                for held, call in calls:
+                    f = call.func
+                    if is_self_attr(f, meth):
+                        eff.append(held | ctx.get(caller, frozenset()))
+            if not eff:
+                continue
+            common = frozenset.intersection(*[frozenset(e) for e in eff])
+            if common and ctx.get(meth) != common:
+                ctx[meth] = common
+                changed = True
+        if not changed:
+            break
+    return ctx
+
+
+@register_pass(("KUKE005", "KUKE006"))
+def check_locks(sources: Sequence[SourceFile],
+                package_root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    classes: list[_ClassInfo] = []
+    classes_by_name: dict[str, list[_ClassInfo]] = {}
+    module_of: dict[str, SourceFile] = {}
+    for src in sources:
+        module_of[_modname(src, package_root)] = src
+
+    # Per-module collection.
+    for src in sources:
+        module_locks = {
+            t.id
+            for stmt in src.tree.body if isinstance(stmt, ast.Assign)
+            and _is_lock_ctor(stmt.value)
+            for t in stmt.targets if isinstance(t, ast.Name)
+        }
+        for node in src.tree.body:
+            if isinstance(node, ast.ClassDef):
+                info = _collect_class(src, node, module_locks)
+                classes.append(info)
+                classes_by_name.setdefault(node.name, []).append(info)
+            elif isinstance(node, ast.FunctionDef):
+                _scan_function(node, None, module_locks)
+
+    # --- KUKE005: locked-somewhere means locked-everywhere ---------------
+    for info in classes:
+        ctx = _locked_context_methods(info)
+        locked_attrs: dict[str, set[str]] = {}
+        for w in info.writes:
+            held = w.locks | ctx.get(w.method, frozenset())
+            if held:
+                locked_attrs.setdefault(w.attr, set()).update(held)
+        for w in info.writes:
+            if w.attr not in locked_attrs:
+                continue
+            if w.attr in info.lock_attrs:
+                continue
+            if any(w.method.startswith(p) for p in INIT_EXEMPT_PREFIXES):
+                continue
+            held = w.locks | ctx.get(w.method, frozenset())
+            if not held:
+                guards = ", ".join(sorted(
+                    f"self.{g}" for g in locked_attrs[w.attr]))
+                findings.append(Finding(
+                    "KUKE005", info.module, w.line,
+                    f"self.{w.attr} is written under {guards} elsewhere "
+                    f"in {info.name} but written without the lock here "
+                    f"({info.name}.{w.method}) — guard this write or "
+                    f"document why the attribute needs no lock at all",
+                    scope=f"{info.name}.{w.method}",
+                    detail=w.attr))
+
+    # --- KUKE006: acquisition-order cycle detection ----------------------
+    # Locks a method of a class acquires (for call-mediated edges).
+    acquires_of: dict[tuple[str, str], set[str]] = {}
+    for info in classes:
+        for meth, locks in info.acquires.items():
+            ids = {
+                info.lock_id(n) if not n.startswith("<module>:")
+                else f"{info.module}:{n[9:]}"
+                for n in locks
+            }
+            if ids:
+                acquires_of[(info.name, meth)] = ids
+
+    edges: dict[tuple[str, str], tuple[str, int]] = {}
+
+    def add_edge(a: str, b: str, module: str, line: int) -> None:
+        if a != b and (a, b) not in edges:
+            edges[(a, b)] = (module, line)
+
+    for info in classes:
+        for caller, calls in info.calls.items():
+            for held, call in calls:
+                if not held:
+                    continue
+                held_ids = [
+                    info.lock_id(n) if not n.startswith("<module>:")
+                    else f"{info.module}:{n[9:]}"
+                    for n in held
+                ]
+                f = call.func
+                callee_acquires: set[str] = set()
+                if is_self_attr(f):
+                    callee_acquires = acquires_of.get(
+                        (info.name, f.attr), set())
+                elif (isinstance(f, ast.Attribute)
+                      and is_self_attr(f.value)):
+                    tname = info.attr_types.get(f.value.attr)
+                    if tname:
+                        for target in classes_by_name.get(tname, ()):
+                            callee_acquires |= acquires_of.get(
+                                (target.name, f.attr), set())
+                for a in held_ids:
+                    for b in callee_acquires:
+                        add_edge(a, b, info.module, call.lineno)
+        # Lexical nesting inside one class: a with-region acquiring a
+        # second lock shows up as acquires during a held region — catch it
+        # by rescanning withs with held context.
+        for meth in info.node.body:
+            if not isinstance(meth, ast.FunctionDef):
+                continue
+            _nested_with_edges(meth, info, add_edge)
+
+    findings.extend(_find_cycles(edges))
+    return findings
+
+
+def _nested_with_edges(fn: ast.FunctionDef, info: _ClassInfo,
+                       add_edge) -> None:
+    def visit(node: ast.AST, held: list[str]) -> None:
+        if isinstance(node, ast.With):
+            got = [n for n in _with_lock_items(node, info, set())]
+            ids = [info.lock_id(n) for n in got]
+            for a in held:
+                for b in ids:
+                    add_edge(a, b, info.module, node.lineno)
+            for child in node.body:
+                visit(child, held + ids)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fn.body:
+        visit(stmt, [])
+
+
+def _find_cycles(edges: dict[tuple[str, str], tuple[str, int]]
+                 ) -> list[Finding]:
+    """Report each elementary cycle once (smallest node first)."""
+    adj: dict[str, list[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    seen_cycles: set[tuple[str, ...]] = set()
+    findings: list[Finding] = []
+
+    def dfs(start: str, node: str, path: list[str],
+            on_path: set[str]) -> None:
+        for nxt in adj.get(node, ()):  # noqa: B007
+            if nxt == start and len(path) >= 1:
+                cyc = path + [start]
+                anchor = min(cyc[:-1])
+                i = cyc.index(anchor)
+                canon = tuple(cyc[i:-1] + cyc[:i])
+                if canon in seen_cycles:
+                    continue
+                seen_cycles.add(canon)
+                module, line = edges[(path[-1], start)]
+                chain = " -> ".join(list(canon) + [canon[0]])
+                findings.append(Finding(
+                    "KUKE006", module, line,
+                    f"lock acquisition-order cycle (potential deadlock): "
+                    f"{chain}",
+                    scope="lock-graph", detail=chain))
+            elif nxt not in on_path:
+                dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return findings
+
+
+def _modname(src: SourceFile, package_root: str) -> str:
+    rel = os.path.relpath(src.path, os.path.dirname(package_root))
+    return rel[:-3].replace(os.sep, ".")

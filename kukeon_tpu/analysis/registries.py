@@ -1,0 +1,153 @@
+"""KUKE007/KUKE008 — declaration registries kept honest, AST-accurately.
+
+These replace the two grep guards that previously lived in the test suite
+(PR 3's fault-point grep, PR 4's README metric-table regex): the AST
+versions see only *code* (no docstring/comment false hits), report
+file:line for every violation, and run both under ``python -m
+kukeon_tpu.analysis`` and inside tier-1 via tests/test_static_analysis.py.
+
+- **KUKE007 — fault-point registry.** Every ``faults.maybe_fail("p")``
+  call site in the package must name a point declared in
+  ``faults.POINTS`` (else it is invisible to the
+  ``kukeon_faults_fired_total`` exposition), and every declared point
+  must have a call site (else the declaration is stale). Dynamic point
+  names (non-literal first argument) are themselves a violation — the
+  registry can only be checked when the name is a literal.
+- **KUKE008 — metric doc-drift.** Every ``kukeon_*`` metric-family
+  literal in the package must appear in the README's metric reference
+  table. The scan is exact string constants (including f-string constant
+  parts would hide a dynamic name, so JoinedStr pieces are ignored —
+  dynamic family names are not used in this codebase and should stay
+  that way).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Sequence
+
+from kukeon_tpu.analysis.core import (
+    Finding, SourceFile, const_str, register_pass,
+)
+
+FAULTS_MODULE = "faults.py"
+METRIC_RE = re.compile(r"kukeon_[a-z0-9_]+\Z")
+# Package-y literals that match the metric shape but are not families.
+METRIC_IGNORE = frozenset({"kukeon_tpu", "kukeon_faults"})
+
+
+def collect_fault_call_sites(sources: Sequence[SourceFile]) -> list[
+        tuple[str, str | None, int]]:
+    """(file, point-or-None-if-dynamic, line) for each maybe_fail call
+    outside faults.py itself."""
+    out: list[tuple[str, str | None, int]] = []
+    for src in sources:
+        if os.path.basename(src.path) == FAULTS_MODULE:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name != "maybe_fail":
+                continue
+            point = const_str(node.args[0]) if node.args else None
+            out.append((src.rel, point, node.lineno))
+    return out
+
+
+def declared_points(sources: Sequence[SourceFile]) -> tuple[
+        dict[str, int], str, int]:
+    """(point -> line, faults.py rel path, POINTS line) parsed from the
+    ``POINTS = (...)`` assignment."""
+    for src in sources:
+        if os.path.basename(src.path) != FAULTS_MODULE:
+            continue
+        for node in src.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == "POINTS"
+                       for t in node.targets):
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                pts = {}
+                for elt in node.value.elts:
+                    s = const_str(elt)
+                    if s is not None:
+                        pts[s] = elt.lineno
+                return pts, src.rel, node.lineno
+    return {}, "", 0
+
+
+@register_pass(("KUKE007",))
+def check_fault_registry(sources: Sequence[SourceFile],
+                         package_root: str) -> list[Finding]:
+    declared, faults_rel, points_line = declared_points(sources)
+    if not faults_rel:
+        return []    # no faults module in this tree (fixture packages)
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for rel, point, line in collect_fault_call_sites(sources):
+        if point is None:
+            findings.append(Finding(
+                "KUKE007", rel, line,
+                "maybe_fail with a non-literal point name: the fault "
+                "registry (faults.POINTS) can only be checked against "
+                "literals — name the point inline",
+                scope="", detail="<dynamic>"))
+            continue
+        seen.add(point)
+        if point not in declared:
+            findings.append(Finding(
+                "KUKE007", rel, line,
+                f"fault point \"{point}\" is not declared in "
+                f"faults.POINTS; undeclared points never appear in the "
+                f"kukeon_faults_fired_total exposition",
+                scope="", detail=point))
+    for point, line in declared.items():
+        if point not in seen:
+            findings.append(Finding(
+                "KUKE007", faults_rel, line,
+                f"faults.POINTS declares \"{point}\" but no "
+                f"maybe_fail(\"{point}\") call site exists — remove the "
+                f"stale declaration",
+                scope="POINTS", detail=point))
+    return findings
+
+
+def collect_metric_literals(sources: Sequence[SourceFile]) -> dict[
+        str, tuple[str, int]]:
+    """metric family -> (file, first line) for every kukeon_* string
+    constant in the package."""
+    out: dict[str, tuple[str, int]] = {}
+    for src in sources:
+        for node in ast.walk(src.tree):
+            s = const_str(node)
+            if s is None or not METRIC_RE.match(s) or s in METRIC_IGNORE:
+                continue
+            if s not in out or (src.rel, node.lineno) < out[s]:
+                out[s] = (src.rel, node.lineno)
+    return out
+
+
+@register_pass(("KUKE008",))
+def check_metric_docs(sources: Sequence[SourceFile],
+                      package_root: str) -> list[Finding]:
+    readme = os.path.join(os.path.dirname(os.path.abspath(package_root)),
+                          "README.md")
+    if not os.path.exists(readme):
+        return []
+    with open(readme, encoding="utf-8") as f:
+        text = f.read()
+    findings: list[Finding] = []
+    for name, (rel, line) in sorted(collect_metric_literals(sources).items()):
+        if name not in text:
+            findings.append(Finding(
+                "KUKE008", rel, line,
+                f"metric family \"{name}\" is not documented in the "
+                f"README metric reference table",
+                scope="", detail=name))
+    return findings

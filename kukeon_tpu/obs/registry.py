@@ -25,7 +25,7 @@ latency metric is cross-comparable and the exposition stays compact.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Iterable
+from typing import Callable, Iterable, TypeVar, cast
 
 # Fixed log-spaced latency ladder: 0.25ms * 2^i, i in [0, 19) -> ~0.25ms,
 # 0.5ms, 1ms, ... 65.5s, 131s. Wide enough for TTFT on a tunneled chip and
@@ -35,9 +35,11 @@ LATENCY_BUCKETS_S: tuple[float, ...] = tuple(
 )
 
 _LabelKey = tuple[str, ...]
+_M = TypeVar("_M", bound="_Metric")
 
 
-def _label_key(label_names: tuple[str, ...], labels: dict) -> _LabelKey:
+def _label_key(label_names: tuple[str, ...],
+               labels: dict[str, object]) -> _LabelKey:
     if set(labels) != set(label_names):
         raise ValueError(
             f"labels {sorted(labels)} != declared {sorted(label_names)}"
@@ -46,10 +48,10 @@ def _label_key(label_names: tuple[str, ...], labels: dict) -> _LabelKey:
 
 
 class _Metric:
-    kind = "untyped"
+    kind: str = "untyped"
 
     def __init__(self, name: str, help: str, label_names: tuple[str, ...],
-                 lock: threading.Lock):
+                 lock: threading.Lock) -> None:
         self.name = name
         self.help = help
         self.label_names = tuple(label_names)
@@ -68,25 +70,26 @@ class Counter(_Metric):
 
     kind = "counter"
 
-    def __init__(self, name, help, label_names, lock):
+    def __init__(self, name: str, help: str, label_names: tuple[str, ...],
+                 lock: threading.Lock) -> None:
         super().__init__(name, help, label_names, lock)
         self._values: dict[_LabelKey, float] = {}
         if not self.label_names:
             self._values[()] = 0.0
 
-    def inc(self, amount: float = 1.0, **labels) -> None:
+    def inc(self, amount: float = 1.0, **labels: object) -> None:
         if amount < 0:
             raise ValueError("counters only go up")
         key = _label_key(self.label_names, labels)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
-    def value(self, **labels) -> float:
+    def value(self, **labels: object) -> float:
         key = _label_key(self.label_names, labels)
         with self._lock:
             return self._values.get(key, 0.0)
 
-    def samples(self):
+    def samples(self) -> list[tuple[dict[str, str], float]]:
         with self._lock:
             items = list(self._values.items())
         return [(dict(zip(self.label_names, k)), v) for k, v in items]
@@ -97,32 +100,34 @@ class Gauge(_Metric):
 
     kind = "gauge"
 
-    def __init__(self, name, help, label_names, lock):
+    def __init__(self, name: str, help: str, label_names: tuple[str, ...],
+                 lock: threading.Lock) -> None:
         super().__init__(name, help, label_names, lock)
         self._values: dict[_LabelKey, float] = {}
         self._fns: dict[_LabelKey, Callable[[], float]] = {}
 
-    def set(self, value: float, **labels) -> None:
+    def set(self, value: float, **labels: object) -> None:
         key = _label_key(self.label_names, labels)
         with self._lock:
             self._values[key] = float(value)
 
-    def inc(self, amount: float = 1.0, **labels) -> None:
+    def inc(self, amount: float = 1.0, **labels: object) -> None:
         key = _label_key(self.label_names, labels)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
-    def dec(self, amount: float = 1.0, **labels) -> None:
+    def dec(self, amount: float = 1.0, **labels: object) -> None:
         self.inc(-amount, **labels)
 
-    def set_function(self, fn: Callable[[], float], **labels) -> None:
+    def set_function(self, fn: Callable[[], float],
+                     **labels: object) -> None:
         """Back this labelset with a callable evaluated at scrape time —
         live values (queue depth, uptime) cost nothing between scrapes."""
         key = _label_key(self.label_names, labels)
         with self._lock:
             self._fns[key] = fn
 
-    def value(self, **labels) -> float:
+    def value(self, **labels: object) -> float:
         key = _label_key(self.label_names, labels)
         with self._lock:
             fn = self._fns.get(key)
@@ -131,7 +136,7 @@ class Gauge(_Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
-    def samples(self):
+    def samples(self) -> list[tuple[dict[str, str], float]]:
         with self._lock:
             items = dict(self._values)
             fns = list(self._fns.items())
@@ -154,8 +159,9 @@ class Histogram(_Metric):
 
     kind = "histogram"
 
-    def __init__(self, name, help, label_names, lock,
-                 buckets: tuple[float, ...] = LATENCY_BUCKETS_S):
+    def __init__(self, name: str, help: str, label_names: tuple[str, ...],
+                 lock: threading.Lock,
+                 buckets: tuple[float, ...] = LATENCY_BUCKETS_S) -> None:
         super().__init__(name, help, label_names, lock)
         b = tuple(sorted(float(x) for x in buckets))
         if not b or any(b[i] >= b[i + 1] for i in range(len(b) - 1)):
@@ -164,7 +170,7 @@ class Histogram(_Metric):
         # per labelset: ([count per finite bucket] + [overflow], sum, count)
         self._series: dict[_LabelKey, tuple[list[int], float, int]] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, **labels: object) -> None:
         key = _label_key(self.label_names, labels)
         v = float(value)
         with self._lock:
@@ -178,7 +184,7 @@ class Histogram(_Metric):
                 counts[-1] += 1
             self._series[key] = (counts, total + v, n + 1)
 
-    def snapshot(self, **labels) -> tuple[list[int], float, int]:
+    def snapshot(self, **labels: object) -> tuple[list[int], float, int]:
         """(per-bucket counts + overflow, sum, count) for one labelset."""
         key = _label_key(self.label_names, labels)
         with self._lock:
@@ -186,18 +192,20 @@ class Histogram(_Metric):
                 key, ([0] * (len(self.buckets) + 1), 0.0, 0))
             return list(counts), total, n
 
-    def percentile(self, q: float, **labels) -> float | None:
+    def percentile(self, q: float, **labels: object) -> float | None:
         """Estimated q-quantile (q in [0,1]) from the bucket counts; None
         with no observations. Overflow observations clamp to the top
         bucket bound (the honest answer a fixed ladder can give)."""
         counts, _total, _n = self.snapshot(**labels)
         return percentile_from_counts(self.buckets, counts, q)
 
-    def samples(self):  # exposition is histogram-shaped; see expo.render
+    def samples(self) -> list[tuple[dict[str, str], float]]:
+        # Exposition is histogram-shaped; see expo.render.
         raise TypeError("histograms expose via expo.render, not samples()")
 
 
-def percentile_from_counts(buckets: tuple[float, ...], counts: list[int],
+def percentile_from_counts(buckets: tuple[float, ...],
+                           counts: "list[int] | tuple[int, ...]",
                            q: float) -> float | None:
     """q-quantile from per-bucket counts (finite buckets + overflow slot).
 
@@ -228,10 +236,10 @@ def percentile_from_counts(buckets: tuple[float, ...], counts: list[int],
 class Registry:
     """A named set of metrics plus scrape-time collectors."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
         self._metrics: dict[str, _Metric] = {}
-        self._collectors: list[Callable[[], Iterable]] = []
+        self._collectors: list[Callable[[], Iterable[object]]] = []
         # Scrape-robustness accounting: a gauge callable or collector that
         # raises at scrape time is skipped — and counted here — instead of
         # 500ing the whole exposition (one bad callback must not blind the
@@ -241,8 +249,8 @@ class Registry:
             "Scrape-time callables (gauge functions, collectors) that "
             "raised; their samples were skipped.", labels=("metric",))
 
-    def _get_or_create(self, cls, name: str, help: str,
-                       label_names: Iterable[str], **kw) -> _Metric:
+    def _get_or_create(self, cls: "type[_M]", name: str, help: str,
+                       label_names: Iterable[str], **kw: object) -> _M:
         label_names = tuple(label_names)
         with self._lock:
             m = self._metrics.get(name)
@@ -252,11 +260,11 @@ class Registry:
                         f"metric {name!r} already registered as "
                         f"{m.kind}{m.label_names}"
                     )
-                return m
-            m = cls(name, help, label_names, self._lock, **kw)
-            m._scrape_errors = getattr(self, "scrape_errors", None)
-            self._metrics[name] = m
-            return m
+                return cast("_M", m)
+            new = cls(name, help, label_names, self._lock, **kw)
+            new._scrape_errors = getattr(self, "scrape_errors", None)
+            self._metrics[name] = new
+            return new
 
     def counter(self, name: str, help: str = "",
                 labels: Iterable[str] = ()) -> Counter:
@@ -280,7 +288,7 @@ class Registry:
         with self._lock:
             return sorted(self._metrics.values(), key=lambda m: m.name)
 
-    def register_collector(self, fn: Callable[[], Iterable]) -> None:
+    def register_collector(self, fn: Callable[[], Iterable[object]]) -> None:
         """``fn() -> iterable of (name, kind, help, [(labels, value), ...])``
         evaluated at every scrape — for families whose source of truth
         lives elsewhere (fault fire counts, cgroup stats)."""
@@ -288,7 +296,7 @@ class Registry:
             if fn not in self._collectors:
                 self._collectors.append(fn)
 
-    def collectors(self) -> list[Callable[[], Iterable]]:
+    def collectors(self) -> list[Callable[[], Iterable[object]]]:
         with self._lock:
             return list(self._collectors)
 

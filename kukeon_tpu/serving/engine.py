@@ -1234,21 +1234,23 @@ class ServingEngine:
         while size * 4 <= self.decode_chunk:
             size *= 4
             chunk_sizes.add(size)
-        temps, top_ks, top_ps = self._slot_sampling_arrays()
+        # Through the counted dirty-flag seam (kukelint KUKE002): one
+        # _upload of the three sampling arrays, reused across every chunk
+        # size, instead of six raw jnp.asarray transfers the budget never
+        # saw.
+        temps_d, top_ks_d, top_ps_d = self._sampling_dev_arrays()
         with set_mesh(self.mesh):
             for k in sorted(chunk_sizes):
                 self._key, k1 = jax.random.split(self._key)
                 if self.paged:
                     self.state, _ = self._decode_chunk_paged(
                         self.params, self.state, self._bt_dev_array(), k1,
-                        jnp.asarray(temps), jnp.asarray(top_ks),
-                        jnp.asarray(top_ps), k,
+                        temps_d, top_ks_d, top_ps_d, k,
                     )
                 else:
                     self.state, _ = self._decode_chunk(
                         self.params, self.state, k1,
-                        jnp.asarray(temps), jnp.asarray(top_ks),
-                        jnp.asarray(top_ps), k,
+                        temps_d, top_ks_d, top_ps_d, k,
                     )
 
     def start(self):
@@ -1567,7 +1569,12 @@ class ServingEngine:
             did_work = True
         self._inflight = new_inflight
         if did_work:
-            self.last_progress = time.monotonic()
+            # Heartbeat writes stay under the admission lock everywhere
+            # (kukelint KUKE005): submit() already updates it locked, and a
+            # torn read on stalled_s()'s watchdog path is not worth the
+            # nanoseconds an uncontended acquire costs per step.
+            with self._lock:
+                self.last_progress = time.monotonic()
         return did_work
 
     def _prefix_lookup(self, req: Request) -> "_CachedPrefix | None":

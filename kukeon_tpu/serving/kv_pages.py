@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 from collections import deque
+from collections.abc import Iterable
 
 import numpy as np
 
@@ -68,7 +69,7 @@ class PageAllocator:
     no locking.
     """
 
-    def __init__(self, num_pages: int, page_tokens: int):
+    def __init__(self, num_pages: int, page_tokens: int) -> None:
         if num_pages < 1:
             raise ValueError(f"need at least 1 usable page, got {num_pages}")
         if page_tokens < 1:
@@ -118,7 +119,7 @@ class PageAllocator:
             self._ref[p] = 1
         return out
 
-    def ref(self, pages) -> None:
+    def ref(self, pages: Iterable[int]) -> None:
         """Add one reference to each page (a new reader of shared pages)."""
         for p in pages:
             if p == SCRATCH_PAGE:
@@ -127,7 +128,7 @@ class PageAllocator:
                 raise ValueError(f"ref of unallocated page {p}")
             self._ref[p] += 1
 
-    def unref(self, pages) -> int:
+    def unref(self, pages: Iterable[int]) -> int:
         """Drop one reference from each page; pages reaching zero return to
         the free list. Returns how many were freed."""
         freed = 0

@@ -347,20 +347,23 @@ def test_every_fault_point_call_site_is_declared():
     """Guard (conftest-level contract): every ``maybe_fail("<point>")``
     call site in the package appears in faults.POINTS, and every declared
     point has a call site — a new fault point can't ship unobservable,
-    and a stale declaration can't linger after a seam is removed."""
-    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(faults.__file__)))
-    call_sites: set[str] = set()
-    for dirpath, _dirs, files in os.walk(os.path.join(pkg_root, "kukeon_tpu")):
-        for fname in files:
-            if not fname.endswith(".py") or fname == "faults.py":
-                continue
-            with open(os.path.join(dirpath, fname)) as f:
-                call_sites.update(
-                    re.findall(r'maybe_fail\(\s*"([^"]+)"', f.read()))
-    assert call_sites == set(faults.POINTS), (
-        f"undeclared fault points {sorted(call_sites - set(faults.POINTS))}; "
-        f"stale declarations {sorted(set(faults.POINTS) - call_sites)}"
-    )
+    and a stale declaration can't linger after a seam is removed.
+
+    Since PR 7 this rides kukelint's AST-accurate KUKE007 registry pass
+    (kukeon_tpu/analysis/registries.py) instead of a regex over source
+    text: dynamic point names are themselves a violation, and failures
+    carry file:line."""
+    from kukeon_tpu.analysis import load_sources, run_analysis
+    from kukeon_tpu.analysis.registries import collect_fault_call_sites
+
+    pkg_root = os.path.dirname(os.path.abspath(faults.__file__))
+    findings = run_analysis(pkg_root, select=["KUKE007"])
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # Vacuity guard: the pass really saw the package's call sites (a scan
+    # rooted in the wrong directory would pass trivially).
+    sites = {p for _f, p, _l in collect_fault_call_sites(
+        load_sources(pkg_root))}
+    assert sites == set(faults.POINTS)
 
 
 @pytest.mark.faults

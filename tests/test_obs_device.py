@@ -7,7 +7,6 @@ from __future__ import annotations
 import http.client
 import json
 import os
-import re
 import threading
 import time
 from http.server import ThreadingHTTPServer
@@ -413,24 +412,20 @@ def test_percentile_overflow_clamps_and_q_clamps():
 def test_every_metric_family_is_documented_in_readme():
     """Doc-drift guard (mirrors the PR-3 faults guard): every metric family
     named in the package must appear in README's metric reference table.
-    Family names are exactly the lowercase kukeon_-prefixed string literals
-    in kukeon_tpu/ — verified against a few knowns so the scan can't decay
-    into vacuity."""
-    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
-        faults.__file__)))
-    names: set[str] = set()
-    for dirpath, _dirs, files in os.walk(os.path.join(pkg_root, "kukeon_tpu")):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            with open(os.path.join(dirpath, fname)) as f:
-                names.update(re.findall(r'"(kukeon_[a-z0-9_]+)"', f.read()))
+
+    Since PR 7 this rides kukelint's KUKE008 pass
+    (kukeon_tpu/analysis/registries.py): families are the exact kukeon_*
+    string constants in the AST (single- and double-quoted alike, no
+    docstring false hits), and failures carry the literal's file:line.
+    Verified against a few knowns so the scan can't decay into vacuity."""
+    from kukeon_tpu.analysis import load_sources, run_analysis
+    from kukeon_tpu.analysis.registries import collect_metric_literals
+
+    pkg_root = os.path.dirname(os.path.abspath(faults.__file__))
+    names = collect_metric_literals(load_sources(pkg_root))
     for must in ("kukeon_engine_ttft_seconds", "kukeon_compiles_total",
                  "kukeon_hbm_bytes_in_use", "kukeon_slo_burn_rate",
                  "kukeon_cell_scrape_ok", "kukeon_scrape_errors_total"):
         assert must in names, f"scan failed to find {must}"
-    with open(os.path.join(pkg_root, "README.md")) as f:
-        readme = f.read()
-    missing = sorted(n for n in names if n not in readme)
-    assert not missing, (
-        f"metric families missing from the README reference table: {missing}")
+    findings = run_analysis(pkg_root, select=["KUKE008"])
+    assert findings == [], "\n".join(f.render() for f in findings)

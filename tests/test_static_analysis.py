@@ -1,0 +1,512 @@
+"""kukelint (kukeon_tpu/analysis): fixture snippets per rule (positive +
+negative), baseline suppression round-trip, and the tier-1 self-check that
+runs the full analyzer over the real package — the static half of the
+invariants the serving/runtime tests enforce dynamically.
+
+Fixtures build a miniature repo under tmp_path (README.md + a package dir
+with a ``serving/engine.py`` / ``faults.py`` where a rule needs one) so
+every rule is proven to fire on a violation and stay silent on conforming
+code, independent of the real tree's state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from kukeon_tpu import faults
+from kukeon_tpu.analysis import (
+    Baseline,
+    BaselineEntry,
+    registered_rules,
+    run_analysis,
+)
+from kukeon_tpu.analysis.__main__ import main as kukelint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_ROOT = os.path.dirname(os.path.abspath(faults.__file__))
+
+# A minimal engine skeleton the hostsync/jit fixtures extend: the seams,
+# two jitted programs (one with a static position), nothing else.
+ENGINE_HEADER = '''\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ServingEngine:
+    def _fetch(self, x):
+        return np.asarray(x)
+
+    def _upload(self, x):
+        return jnp.asarray(x)
+
+    def _build_programs(self):
+        def insert(state, kv, length, slot, token):
+            return state
+
+        def decode_chunk_fn(params, state, key, n_steps):
+            return state, key
+
+        self._insert = jax.jit(insert, donate_argnums=(0,))
+        self._decode_chunk = jax.jit(decode_chunk_fn, static_argnums=(3,))
+'''
+
+
+def _mini_repo(tmp_path, files: dict[str, str], readme: str = "docs\n"):
+    """Write a throwaway repo (README + package) and return its package
+    root for run_analysis."""
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    (tmp_path / "README.md").write_text(readme)
+    pkg = tmp_path / "pkg"
+    for rel, body in files.items():
+        path = pkg / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(body))
+    return str(pkg)
+
+
+def _engine_repo(tmp_path, methods: str, readme: str = "docs\n"):
+    return _mini_repo(
+        tmp_path,
+        {"serving/engine.py": ENGINE_HEADER + textwrap.indent(
+            textwrap.dedent(methods), "    ")},
+        readme=readme,
+    )
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# --- KUKE001: device→host discipline -----------------------------------------
+
+
+def test_kuke001_flags_raw_readbacks(tmp_path):
+    pkg = _engine_repo(tmp_path, '''
+        def step(self):
+            toks = self._decode_chunk(self.params, self.state, 0, 4)
+            a = int(toks[0, 0])
+            b = np.asarray(self.state.tokens)
+            c = toks.item()
+            jax.device_get(toks)
+            toks.block_until_ready()
+            return a, b, c
+    ''')
+    found = run_analysis(pkg, select=["KUKE001"])
+    details = sorted(f.detail for f in found)
+    assert details == ["block_until_ready", "coerce.int", "device_get",
+                       "item", "np.asarray"]
+    assert all(f.rule == "KUKE001" for f in found)
+    assert all(f.file.endswith("serving/engine.py") for f in found)
+
+
+def test_kuke001_silent_on_routed_and_metadata(tmp_path):
+    pkg = _engine_repo(tmp_path, '''
+        def step(self):
+            toks = self._fetch(self._decode_chunk(self.params, self.state, 0, 4))
+            a = int(toks[0, 0])            # host numpy: fine
+            n = int(self.state.tokens.shape[0])   # static metadata: fine
+            p = np.asarray([1, 2], np.int32)      # host literal: fine
+            return a, n, p
+    ''')
+    assert run_analysis(pkg, select=["KUKE001"]) == []
+
+
+# --- KUKE002: host→device discipline -----------------------------------------
+
+
+def test_kuke002_flags_raw_upload_and_respects_scope(tmp_path):
+    pkg = _engine_repo(tmp_path, '''
+        def step(self):
+            return self._decode_chunk(self.params, jnp.asarray([0]), 0, 4)
+
+        def precompile(self):
+            return jnp.asarray([0])   # not a hot-path method: out of scope
+    ''')
+    found = run_analysis(pkg, select=["KUKE002"])
+    assert _rules(found) == ["KUKE002"]
+    assert found[0].scope == "ServingEngine.step"
+
+
+def test_kuke002_silent_when_routed_through_upload(tmp_path):
+    pkg = _engine_repo(tmp_path, '''
+        def step(self):
+            return self._decode_chunk(
+                self.params, self._upload([0]), 0, 4)
+    ''')
+    assert run_analysis(pkg, select=["KUKE002"]) == []
+
+
+# --- KUKE003: containers in traced positions ---------------------------------
+
+
+def test_kuke003_flags_container_in_traced_position(tmp_path):
+    pkg = _engine_repo(tmp_path, '''
+        def step(self):
+            s1 = self._insert(self.state, [1, 2], 8, 0, 1)
+            s2 = self._insert.lower(self.state, [1, 2], 8, 0, 1).compile()
+            return s1, s2
+    ''')
+    found = run_analysis(pkg, select=["KUKE003"])
+    assert _rules(found) == ["KUKE003", "KUKE003"]
+    assert all(f.detail == "_insert[1]" for f in found)
+
+
+def test_kuke003_static_positions_are_exempt(tmp_path):
+    pkg = _engine_repo(tmp_path, '''
+        def step(self, kv):
+            # arg 3 is static_argnums on _decode_chunk: containers allowed.
+            return self._decode_chunk(self.params, self.state, 0, (1, 2))
+    ''')
+    assert run_analysis(pkg, select=["KUKE003"]) == []
+
+
+# --- KUKE004: closures over mutable engine state -----------------------------
+
+
+def test_kuke004_flags_mutable_closure(tmp_path):
+    pkg = _mini_repo(tmp_path, {"serving/engine.py": '''\
+        import jax
+
+
+        class ServingEngine:
+            def _build_programs(self):
+                def insert(state, kv, length, slot, token):
+                    return state, self._slot_len[slot]
+
+                self._insert = jax.jit(insert, donate_argnums=(0,))
+    '''})
+    found = run_analysis(pkg, select=["KUKE004"])
+    assert _rules(found) == ["KUKE004"]
+    assert found[0].detail == "self._slot_len"
+
+
+def test_kuke004_allows_frozen_config(tmp_path):
+    pkg = _mini_repo(tmp_path, {"serving/engine.py": '''\
+        import jax
+
+
+        class ServingEngine:
+            def _build_programs(self):
+                def insert(state, kv, length, slot, token):
+                    return state, min(self._bucket(length), self.max_seq_len)
+
+                self._insert = jax.jit(insert, donate_argnums=(0,))
+    '''})
+    assert run_analysis(pkg, select=["KUKE004"]) == []
+
+
+# --- KUKE005: locked-somewhere means locked-everywhere -----------------------
+
+LOCKED_CLASS = '''
+    import threading
+
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.depth = 0
+
+        def locked_bump(self):
+            with self._lock:
+                self.depth += 1
+'''
+
+
+def test_kuke005_flags_unlocked_write(tmp_path):
+    pkg = _mini_repo(tmp_path, {"runtime/thing.py": LOCKED_CLASS + '''
+        def racy(self):
+            self.depth = 5
+    '''})
+    found = run_analysis(pkg, select=["KUKE005"])
+    assert _rules(found) == ["KUKE005"]
+    assert found[0].detail == "depth"
+    assert found[0].scope == "Engine.racy"
+
+
+def test_kuke005_allows_init_and_call_mediated_lock_context(tmp_path):
+    pkg = _mini_repo(tmp_path, {"runtime/thing.py": LOCKED_CLASS + '''
+        def outer(self):
+            with self._lock:
+                self._reset()
+
+        def _reset(self):
+            # Every intra-class call site holds the lock: counts as locked.
+            self.depth = 0
+    '''})
+    assert run_analysis(pkg, select=["KUKE005"]) == []
+
+
+# --- KUKE006: lock-order cycles ----------------------------------------------
+
+
+def test_kuke006_flags_lexical_order_cycle(tmp_path):
+    pkg = _mini_repo(tmp_path, {"runtime/thing.py": '''
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    '''})
+    found = run_analysis(pkg, select=["KUKE006"])
+    assert _rules(found) == ["KUKE006"]
+    assert "_a_lock" in found[0].detail and "_b_lock" in found[0].detail
+
+
+def test_kuke006_flags_call_mediated_cross_class_cycle(tmp_path):
+    pkg = _mini_repo(tmp_path, {"runtime/pair.py": '''
+        import threading
+
+
+        class Eng:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.reg = Reg()
+
+            def poke(self):
+                with self._lock:
+                    self.reg.bump()
+
+            def kick(self):
+                with self._lock:
+                    pass
+
+
+        class Reg:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.eng = Eng()
+
+            def bump(self):
+                with self._lock:
+                    self.eng.kick()
+    '''})
+    found = run_analysis(pkg, select=["KUKE006"])
+    assert _rules(found) == ["KUKE006"]
+
+
+def test_kuke006_silent_on_consistent_order(tmp_path):
+    pkg = _mini_repo(tmp_path, {"runtime/thing.py": '''
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+    '''})
+    assert run_analysis(pkg, select=["KUKE006"]) == []
+
+
+# --- KUKE007: fault-point registry -------------------------------------------
+
+FAULTS_FIXTURE = '''
+    POINTS = (
+        "a.b",
+        "stale.point",
+    )
+
+    def maybe_fail(point):
+        pass
+'''
+
+
+def test_kuke007_flags_undeclared_stale_and_dynamic(tmp_path):
+    pkg = _mini_repo(tmp_path, {
+        "faults.py": FAULTS_FIXTURE,
+        "mod.py": '''
+            from pkg import faults
+
+            def f(name):
+                faults.maybe_fail("a.b")        # declared: fine
+                faults.maybe_fail("c.d")        # undeclared
+                faults.maybe_fail(name)         # dynamic
+        ''',
+    })
+    found = run_analysis(pkg, select=["KUKE007"])
+    details = sorted(f.detail for f in found)
+    assert details == ["<dynamic>", "c.d", "stale.point"]
+
+
+def test_kuke007_silent_when_registry_matches(tmp_path):
+    pkg = _mini_repo(tmp_path, {
+        "faults.py": '''
+            POINTS = ("a.b",)
+        ''',
+        "mod.py": '''
+            from pkg import faults
+
+            def f():
+                faults.maybe_fail("a.b")
+        ''',
+    })
+    assert run_analysis(pkg, select=["KUKE007"]) == []
+
+
+# --- KUKE008: metric doc-drift -----------------------------------------------
+
+
+def test_kuke008_flags_undocumented_metric(tmp_path):
+    pkg = _mini_repo(tmp_path, {
+        "mod.py": 'NAME = "kukeon_test_total"\n',
+    }, readme="# metrics\n\nnothing here\n")
+    found = run_analysis(pkg, select=["KUKE008"])
+    assert _rules(found) == ["KUKE008"]
+    assert found[0].detail == "kukeon_test_total"
+
+
+def test_kuke008_silent_when_documented(tmp_path):
+    pkg = _mini_repo(tmp_path, {
+        "mod.py": 'NAME = "kukeon_test_total"\n',
+    }, readme="| `kukeon_test_total` | counter | test |\n")
+    assert run_analysis(pkg, select=["KUKE008"]) == []
+
+
+# --- baseline suppression ----------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    pkg = _mini_repo(tmp_path, {"runtime/thing.py": LOCKED_CLASS + '''
+        def racy(self):
+            self.depth = 5
+    '''})
+    found = run_analysis(pkg, select=["KUKE005"])
+    assert len(found) == 1
+
+    # Baseline the finding: the same tree now reports clean.
+    bl_path = str(tmp_path / "baseline.json")
+    Baseline([BaselineEntry(found[0].fingerprint,
+                            "intentional: fixture")]).save(bl_path)
+    new, suppressed, stale = Baseline.load(bl_path).apply(found)
+    assert (len(new), len(suppressed), len(stale)) == (0, 1, 0)
+
+    # The justification survives the file round trip.
+    with open(bl_path) as f:
+        data = json.load(f)
+    assert data["suppressions"][0]["justification"] == "intentional: fixture"
+
+    # A *new* violation is NOT suppressed by the existing entry — while
+    # the baselined one stays suppressed (fingerprints are scope-level,
+    # line-independent).
+    pkg2 = _mini_repo(tmp_path / "v2", {
+        "runtime/thing.py": LOCKED_CLASS + '''
+        def racy(self):
+            self.depth = 5
+
+        def racy2(self):
+            self.depth = 6
+        '''})
+    found2 = run_analysis(pkg2, select=["KUKE005"])
+    new2, suppressed2, _stale2 = Baseline.load(bl_path).apply(found2)
+    assert [f.scope for f in new2] == ["Engine.racy2"]
+    assert [f.scope for f in suppressed2] == ["Engine.racy"]
+
+    # Fixing the violation leaves the entry stale — visibly.
+    pkg3 = _mini_repo(tmp_path / "v3", {
+        "runtime/thing.py": LOCKED_CLASS})
+    new3, suppressed3, stale3 = Baseline.load(bl_path).apply(
+        run_analysis(pkg3, select=["KUKE005"]))
+    assert (new3, suppressed3) == ([], [])
+    assert len(stale3) == 1
+
+
+def test_cli_baseline_modes(tmp_path, capsys):
+    pkg = _mini_repo(tmp_path, {"runtime/thing.py": LOCKED_CLASS + '''
+        def racy(self):
+            self.depth = 5
+    '''})
+    bl = str(tmp_path / "bl.json")
+
+    # New finding, no baseline: exit 1.
+    assert kukelint_main([pkg, "--baseline", bl,
+                          "--select", "KUKE005"]) == 1
+    # --update-baseline captures it; the run is then clean.
+    assert kukelint_main([pkg, "--baseline", bl, "--select", "KUKE005",
+                          "--update-baseline"]) == 0
+    assert kukelint_main([pkg, "--baseline", bl,
+                          "--select", "KUKE005"]) == 0
+    # Fix the violation: stale entry passes by default, fails strict mode.
+    pkg_fixed = _mini_repo(tmp_path / "fixed",
+                           {"runtime/thing.py": LOCKED_CLASS})
+    assert kukelint_main([pkg_fixed, "--baseline", bl,
+                          "--select", "KUKE005"]) == 0
+    assert kukelint_main([pkg_fixed, "--baseline", bl, "--select", "KUKE005",
+                          "--strict-baseline"]) == 1
+    capsys.readouterr()
+
+
+# --- the real tree (tier-1 acceptance) ---------------------------------------
+
+
+def test_all_rules_are_registered():
+    assert registered_rules() == (
+        "KUKE001", "KUKE002", "KUKE003", "KUKE004",
+        "KUKE005", "KUKE006", "KUKE007", "KUKE008",
+    )
+
+
+def test_analyzer_package_passes_its_own_lint():
+    """Self-check: the analyzer (as part of the package scan) and the whole
+    tree report nothing beyond the checked-in baseline. This is the tier-1
+    enforcement of every invariant kukelint covers: a new raw transfer,
+    unstable jit call, unlocked write, lock cycle, undeclared fault point,
+    or undocumented metric fails HERE with file:line."""
+    findings = run_analysis(PKG_ROOT)
+    baseline = Baseline.load(os.path.join(PKG_ROOT, "analysis",
+                                          "baseline.json"))
+    new, _suppressed, stale = baseline.apply(findings)
+    assert new == [], "\n".join(f.render() for f in new)
+    assert stale == [], [e.fingerprint for e in stale]
+
+
+def test_cli_runs_clean_on_the_real_package():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kukeon_tpu.analysis", "--strict-baseline"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "finding(s)" in proc.stdout
+
+
+# --- mypy gate (skip-if-unavailable) -----------------------------------------
+
+
+def test_mypy_strict_modules_typecheck():
+    """The two strictly-annotated modules (pyproject [tool.mypy] overrides:
+    obs/registry.py, serving/kv_pages.py) pass mypy. Skips cleanly where
+    mypy is not installed — the container does not bake it."""
+    pytest.importorskip("mypy")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mypy",
+         "kukeon_tpu/obs/registry.py", "kukeon_tpu/serving/kv_pages.py"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

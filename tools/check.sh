@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Pre-PR gate: byte-compile the tree, run kukelint (strict baseline mode —
+# stale suppressions fail too), and run mypy on the strictly-annotated
+# modules when mypy is installed. Exits non-zero on any new finding.
+#
+#   ./tools/check.sh
+#
+# This is the same set of checks tier-1 runs via
+# tests/test_static_analysis.py, packaged for the editing loop: seconds,
+# no jax import, no test collection.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "check.sh: compileall"
+python -m compileall -q kukeon_tpu tests bench.py
+
+echo "check.sh: kukelint (python -m kukeon_tpu.analysis)"
+python -m kukeon_tpu.analysis --strict-baseline
+
+if python -c "import mypy" >/dev/null 2>&1; then
+    echo "check.sh: mypy (strict modules)"
+    python -m mypy kukeon_tpu/obs/registry.py kukeon_tpu/serving/kv_pages.py
+else
+    echo "check.sh: mypy not installed — skipping the strict-module check"
+fi
+
+echo "check.sh: all gates green"
