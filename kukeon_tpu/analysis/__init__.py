@@ -19,6 +19,7 @@ KUKE005  attribute written under a lock somewhere, written unlocked elsewhere
 KUKE006  lock acquisition-order cycle (potential deadlock)
 KUKE007  fault point not declared in faults.POINTS (or stale declaration)
 KUKE008  ``kukeon_*`` metric family missing from the README reference table
+KUKE009  sub-10ms ``time.sleep`` polling loop (busy-wait in disguise)
 ======== =====================================================================
 
 Zero-dependency by design (stdlib ``ast`` only): importable and runnable
@@ -26,6 +27,15 @@ without jax, so it can gate commits anywhere the repo checks out. The
 checked-in baseline (``analysis/baseline.json``) suppresses accepted
 pre-existing findings — a new violation fails the run and the tier-1
 test in tests/test_static_analysis.py.
+
+kukelint is the *static* half of a pair: the KUKE005 guarded-by sets it
+infers are exported as a machine-readable contract
+(``--write-contracts`` → ``analysis/guarded_by.json``) that the dynamic
+concurrency sanitizer — kukesan, ``kukeon_tpu/sanitize``, armed by
+``KUKEON_SANITIZE=1`` — enforces while the test suite actually runs,
+and kukesan merges its runtime-observed lock-acquisition graph back
+into the KUKE006 static graph to report the edges (callback-reached
+locks, dynamically started threads) the AST pass cannot see.
 """
 
 from kukeon_tpu.analysis.core import (
@@ -37,13 +47,23 @@ from kukeon_tpu.analysis.core import (
     registered_rules,
     run_analysis,
 )
+from kukeon_tpu.analysis.locks import (
+    build_lock_graph,
+    default_contracts_path,
+    guarded_contracts,
+    render_contracts,
+)
 
 __all__ = [
     "Baseline",
     "BaselineEntry",
     "Finding",
+    "build_lock_graph",
     "default_baseline_path",
+    "default_contracts_path",
+    "guarded_contracts",
     "load_sources",
     "registered_rules",
+    "render_contracts",
     "run_analysis",
 ]

@@ -4,17 +4,26 @@ Exit codes: 0 = clean (all findings baseline-suppressed), 1 = new
 findings, 2 = bad usage. Stale baseline entries are reported but do not
 fail the run (they fail ``--strict-baseline``, which tools/check.sh and
 the tier-1 self-check use so the baseline cannot rot).
+
+``--format json`` emits one machine-readable document (stable finding
+ids = baseline fingerprints, file:line, stale entries) for tooling;
+``--format github`` emits ``::error file=…,line=…`` workflow commands so
+CI annotates findings inline on the PR diff. ``--write-contracts``
+regenerates the KUKE005 guarded-by contract file
+(``analysis/guarded_by.json``) that the dynamic sanitizer (kukesan)
+enforces at runtime.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 from kukeon_tpu.analysis.core import (
-    Baseline, BaselineEntry, default_baseline_path, registered_rules,
-    run_analysis,
+    Baseline, BaselineEntry, default_baseline_path, load_sources,
+    registered_rules, run_analysis,
 )
 
 
@@ -47,11 +56,35 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print the registered rule ids and exit")
+    parser.add_argument(
+        "--format", default="text", choices=("text", "json", "github"),
+        dest="fmt",
+        help="finding output format: human text (default), one JSON "
+             "document for tooling, or GitHub workflow commands for "
+             "inline CI annotations")
+    parser.add_argument(
+        "--write-contracts", nargs="?", const="", default=None,
+        metavar="PATH",
+        help="regenerate the KUKE005 guarded-by contract file consumed "
+             "by the kukesan runtime sanitizer (default path: "
+             "kukeon_tpu/analysis/guarded_by.json) and exit")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for rule in registered_rules():
             print(rule)
+        return 0
+
+    if args.write_contracts is not None:
+        from kukeon_tpu.analysis import locks
+
+        path = args.write_contracts or locks.default_contracts_path()
+        contracts = locks.guarded_contracts(
+            load_sources(args.package_root), args.package_root)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(locks.render_contracts(contracts))
+        print(f"kukelint: guarded-by contract for {len(contracts)} "
+              f"class(es) written to {path}")
         return 0
 
     select = args.select.split(",") if args.select else None
@@ -79,13 +112,46 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     new, suppressed, stale = baseline.apply(findings)
-    for f in new:
-        print(f.render())
-    for e in stale:
-        print(f"kukelint: stale baseline entry (matches nothing): "
-              f"{e.fingerprint}")
-    print(f"kukelint: {len(new)} finding(s), {len(suppressed)} suppressed "
-          f"by baseline, {len(stale)} stale baseline entr(ies)")
+    if args.fmt == "json":
+        # One machine-readable document: stable ids (the baseline
+        # fingerprint doubles as the finding id — line-independent, so
+        # tooling can track a finding across unrelated edits), file:line
+        # for annotation placement, and the stale entries CI should nag
+        # about. kukesan findings serialize to the same shape
+        # (sanitize/runtime.py SanFinding.to_dict), so one consumer
+        # handles both analyzers' reports.
+        print(json.dumps({
+            "version": 1,
+            "tool": "kukelint",
+            "findings": [
+                {"id": f.fingerprint, "rule": f.rule, "file": f.file,
+                 "line": f.line, "scope": f.scope, "detail": f.detail,
+                 "message": f.message}
+                for f in new
+            ],
+            "suppressed": len(suppressed),
+            "stale_baseline_entries": [e.fingerprint for e in stale],
+        }, indent=2))
+    elif args.fmt == "github":
+        for f in new:
+            # Workflow-command escaping: newlines/%/CR would truncate the
+            # annotation message.
+            msg = (f.message.replace("%", "%25").replace("\r", "%0D")
+                   .replace("\n", "%0A"))
+            print(f"::error file={f.file},line={f.line},"
+                  f"title={f.rule}::{msg}")
+        for e in stale:
+            print(f"::warning title=kukelint stale baseline::"
+                  f"baseline entry matches nothing: {e.fingerprint}")
+    else:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(f"kukelint: stale baseline entry (matches nothing): "
+                  f"{e.fingerprint}")
+        print(f"kukelint: {len(new)} finding(s), {len(suppressed)} "
+              f"suppressed by baseline, {len(stale)} stale baseline "
+              f"entr(ies)")
     if new:
         return 1
     if stale and args.strict_baseline:

@@ -1,0 +1,101 @@
+"""KUKE009 — sub-10ms sleep-polling loops in package hot paths.
+
+A loop whose body sleeps for less than 10ms is a busy-wait in disguise: it
+burns a core, wakes the scheduler ~1000×/s, and adds up to a full sleep
+quantum of latency to the event it is polling for — all to emulate what a
+``threading.Condition``/``Event`` signal does for free. PR 8 replaced the
+engine loop's ``time.sleep(0.001)`` with a condition-variable work signal;
+this rule keeps the pattern from silently returning anywhere in the
+package.
+
+Detection: a ``time.sleep(X)`` call lexically inside a ``while``/``for``
+loop where ``X`` is a numeric literal (or a module-level constant assigned
+one) below :data:`THRESHOLD_S`. Sleeps at or above 10ms are judged
+acceptable poll intervals (drain/rollout polling); nested function bodies
+are skipped (they run on someone else's schedule, not the loop's).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Sequence
+
+from kukeon_tpu.analysis.core import (
+    Finding, SourceFile, qualname, register_pass,
+)
+
+THRESHOLD_S = 0.01
+
+
+def _module_consts(tree: ast.Module) -> dict[str, float]:
+    out: dict[str, float] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, (int, float))
+                and not isinstance(stmt.value.value, bool)):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = float(stmt.value.value)
+    return out
+
+
+def _sleep_seconds(call: ast.Call,
+                   consts: dict[str, float]) -> float | None:
+    """The literal/constant duration of a ``time.sleep(X)`` call, else
+    None (dynamic durations are not judged — they may be long)."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "sleep"
+            and isinstance(f.value, ast.Name) and f.value.id == "time"):
+        return None
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if (isinstance(arg, ast.Constant)
+            and isinstance(arg.value, (int, float))
+            and not isinstance(arg.value, bool)):
+        return float(arg.value)
+    if isinstance(arg, ast.Name):
+        return consts.get(arg.id)
+    return None
+
+
+@register_pass(("KUKE009",))
+def check_busywait(sources: Sequence[SourceFile],
+                   package_root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in sources:
+        consts = _module_consts(src.tree)
+
+        def visit(node: ast.AST, stack: list[ast.AST],
+                  loop_depth: int) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                # A nested scope's body does not run inside the enclosing
+                # loop's iterations: reset the loop context.
+                for child in ast.iter_child_nodes(node):
+                    visit(child, stack + [node], 0)
+                return
+            if isinstance(node, ast.Lambda):
+                for child in ast.iter_child_nodes(node):
+                    visit(child, stack, 0)
+                return
+            if isinstance(node, (ast.While, ast.For)):
+                loop_depth += 1
+            if isinstance(node, ast.Call) and loop_depth > 0:
+                s = _sleep_seconds(node, consts)
+                if s is not None and s < THRESHOLD_S:
+                    scope = qualname(stack) or "<module>"
+                    findings.append(Finding(
+                        "KUKE009", src.rel, node.lineno,
+                        f"time.sleep({s:g}) inside a loop is a sub-10ms "
+                        f"busy-wait — signal the loop with a "
+                        f"threading.Condition/Event (notify on the state "
+                        f"change it polls for) instead of spin-sleeping",
+                        scope=scope, detail=f"sleep:{s:g}"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, stack, loop_depth)
+
+        for stmt in src.tree.body:
+            visit(stmt, [], 0)
+    return findings

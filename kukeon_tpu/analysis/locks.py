@@ -13,7 +13,11 @@ properties keep them honest, both checkable from the AST:
   call site sits inside a region of the same lock is treated as running
   under that lock (one level of call-mediated context, computed to a
   fixed point), so ``call()``-holds-the-lock-then-calls-``_ensure_conn``
-  patterns do not false-positive.
+  patterns do not false-positive. Inference can be supplemented with an
+  explicit ``# guarded-by: <lock>`` comment on any ``self.attr = …``
+  statement (normally the constructor's): the attribute joins the
+  guarded set even when no locked write exists yet for inference to
+  learn from.
 - **KUKE006 — acquisition-order cycles.** A directed graph over every
   lock in the package: edge A→B when code acquires B while holding A,
   either lexically (nested ``with``) or through a call made inside A's
@@ -26,11 +30,22 @@ properties keep them honest, both checkable from the AST:
   name-collision noise.
 
 Lock identification: an attribute assigned ``threading.Lock()`` /
-``RLock()`` (instance or class level), a module-level name so assigned,
-or — for classes that receive a lock by injection — any ``with self.X:``
-where ``X`` contains ``lock`` or ``mu`` (the obs registry hands its lock
-to the metrics it creates; the convention is load-bearing and cheap to
-honor).
+``RLock()`` (instance or class level) or the sanitize factory's
+``sanitize.lock()`` / ``sanitize.rlock()``, a module-level name so
+assigned, or — for classes that receive a lock by injection — any
+``with self.X:`` where ``X`` contains ``lock`` or ``mu`` (the obs
+registry hands its lock to the metrics it creates; the convention is
+load-bearing and cheap to honor).
+
+The per-class guarded-attribute sets this pass infers are also the
+**guarded-by contract** the dynamic sanitizer (kukeon_tpu/sanitize,
+"kukesan") enforces at runtime: :func:`guarded_contracts` exports them,
+``python -m kukeon_tpu.analysis --write-contracts`` persists them to
+``analysis/guarded_by.json``, and kukesan's ``__setattr__`` hooks check
+every write against that file while the suite runs under
+``KUKEON_SANITIZE=1``. Likewise :func:`build_lock_graph` exposes the
+KUKE006 edge set so kukesan can diff the runtime-observed acquisition
+graph against the static one (sanitize/report.py).
 """
 
 from __future__ import annotations
@@ -38,6 +53,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import os
+import re
 from typing import Sequence
 
 from kukeon_tpu.analysis.core import (
@@ -48,12 +64,20 @@ INIT_EXEMPT_PREFIXES = ("__init__", "__post_init__", "_init")
 
 _LOCKY = ("lock", "mu", "mutex")
 
+# ``self.attr = …  # guarded-by: _lock`` (comma-separated lock names).
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z0-9_,\s]+)")
+
 
 def _is_lock_ctor(node: ast.AST) -> bool:
-    """``threading.Lock()`` / ``threading.RLock()`` / bare ``Lock()``."""
+    """``threading.Lock()`` / ``threading.RLock()`` / bare ``Lock()`` /
+    the sanitize factory's ``sanitize.lock()`` / ``sanitize.rlock()``."""
     if not isinstance(node, ast.Call):
         return False
     f = node.func
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id in ("sanitize", "san")
+            and f.attr in ("lock", "rlock")):
+        return True
     name = f.attr if isinstance(f, ast.Attribute) else (
         f.id if isinstance(f, ast.Name) else None)
     return name in ("Lock", "RLock")
@@ -86,9 +110,29 @@ class _ClassInfo:
     acquires: dict[str, set[str]] = dataclasses.field(default_factory=dict)
     # self.attr -> class name assigned via ``self.attr = ClassName(...)``
     attr_types: dict[str, str] = dataclasses.field(default_factory=dict)
+    # attr -> lock names from explicit ``# guarded-by:`` annotations.
+    declared: dict[str, set[str]] = dataclasses.field(default_factory=dict)
 
     def lock_id(self, lock_name: str) -> str:
         return f"{self.module}:{self.name}.{lock_name}"
+
+    def guarded_attrs(self) -> dict[str, set[str]]:
+        """attr -> self-attr lock names guarding it: the union of inference
+        (written under a lock anywhere outside init) and explicit
+        ``# guarded-by:`` declarations. Lock attributes themselves and
+        module-level lock guards are excluded — the contract consumer
+        (kukesan's ``__setattr__`` hook) can only resolve ``self.<lock>``."""
+        ctx = _locked_context_methods(self)
+        out: dict[str, set[str]] = {}
+        for w in self.writes:
+            held = w.locks | ctx.get(w.method, frozenset())
+            held = {h for h in held if not h.startswith("<module>:")}
+            if held and w.attr not in self.lock_attrs:
+                out.setdefault(w.attr, set()).update(held)
+        for attr, locks in self.declared.items():
+            if attr not in self.lock_attrs:
+                out.setdefault(attr, set()).update(locks)
+        return out
 
 
 def _with_lock_items(node: ast.With, cls: "_ClassInfo | None",
@@ -174,8 +218,21 @@ def _ctor_name(call: ast.Call) -> str | None:
     return None
 
 
+def _marker_lines(text: str) -> dict[int, set[str]]:
+    """lineno -> lock names for every ``# guarded-by: A, B`` comment."""
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = _GUARDED_BY_RE.search(line)
+        if m:
+            names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+            if names:
+                out[i] = names
+    return out
+
+
 def _collect_class(src: SourceFile, node: ast.ClassDef,
-                   module_locks: set[str]) -> _ClassInfo:
+                   module_locks: set[str],
+                   markers: dict[int, set[str]]) -> _ClassInfo:
     info = _ClassInfo(module=src.rel, name=node.name, node=node)
     # Pre-pass: find declared lock attributes (instance + class level).
     for sub in ast.walk(node):
@@ -188,6 +245,12 @@ def _collect_class(src: SourceFile, node: ast.ClassDef,
     for meth in node.body:
         if isinstance(meth, ast.FunctionDef):
             _scan_function(meth, info, module_locks)
+    # Explicit guard declarations: a write line carrying a guarded-by
+    # comment binds the written attribute(s) to the named lock(s).
+    for w in info.writes:
+        names = markers.get(w.line)
+        if names:
+            info.declared.setdefault(w.attr, set()).update(names)
     return info
 
 
@@ -226,17 +289,12 @@ def _locked_context_methods(info: _ClassInfo) -> dict[str, frozenset]:
     return ctx
 
 
-@register_pass(("KUKE005", "KUKE006"))
-def check_locks(sources: Sequence[SourceFile],
-                package_root: str) -> list[Finding]:
-    findings: list[Finding] = []
+def _collect_model(sources: Sequence[SourceFile], package_root: str
+                   ) -> tuple[list[_ClassInfo], dict[str, list[_ClassInfo]]]:
+    """Parse every class's lock model once (shared by the KUKE005/006
+    checks, the guarded-by contract export, and the lock-graph export)."""
     classes: list[_ClassInfo] = []
     classes_by_name: dict[str, list[_ClassInfo]] = {}
-    module_of: dict[str, SourceFile] = {}
-    for src in sources:
-        module_of[_modname(src, package_root)] = src
-
-    # Per-module collection.
     for src in sources:
         module_locks = {
             t.id
@@ -244,43 +302,30 @@ def check_locks(sources: Sequence[SourceFile],
             and _is_lock_ctor(stmt.value)
             for t in stmt.targets if isinstance(t, ast.Name)
         }
+        markers = _marker_lines(src.text)
         for node in src.tree.body:
             if isinstance(node, ast.ClassDef):
-                info = _collect_class(src, node, module_locks)
+                info = _collect_class(src, node, module_locks, markers)
                 classes.append(info)
                 classes_by_name.setdefault(node.name, []).append(info)
             elif isinstance(node, ast.FunctionDef):
                 _scan_function(node, None, module_locks)
+    return classes, classes_by_name
 
-    # --- KUKE005: locked-somewhere means locked-everywhere ---------------
-    for info in classes:
-        ctx = _locked_context_methods(info)
-        locked_attrs: dict[str, set[str]] = {}
-        for w in info.writes:
-            held = w.locks | ctx.get(w.method, frozenset())
-            if held:
-                locked_attrs.setdefault(w.attr, set()).update(held)
-        for w in info.writes:
-            if w.attr not in locked_attrs:
-                continue
-            if w.attr in info.lock_attrs:
-                continue
-            if any(w.method.startswith(p) for p in INIT_EXEMPT_PREFIXES):
-                continue
-            held = w.locks | ctx.get(w.method, frozenset())
-            if not held:
-                guards = ", ".join(sorted(
-                    f"self.{g}" for g in locked_attrs[w.attr]))
-                findings.append(Finding(
-                    "KUKE005", info.module, w.line,
-                    f"self.{w.attr} is written under {guards} elsewhere "
-                    f"in {info.name} but written without the lock here "
-                    f"({info.name}.{w.method}) — guard this write or "
-                    f"document why the attribute needs no lock at all",
-                    scope=f"{info.name}.{w.method}",
-                    detail=w.attr))
 
-    # --- KUKE006: acquisition-order cycle detection ----------------------
+def build_lock_graph(sources: Sequence[SourceFile], package_root: str
+                     ) -> dict[tuple[str, str], tuple[str, int]]:
+    """The KUKE006 acquisition-order graph: ``(held, acquired) -> (module,
+    line)`` over lock ids of the form ``path/to/file.py:Class.lock``.
+    Exposed so kukesan can merge the runtime-observed graph with this one
+    and report the edges the static pass could not see."""
+    classes, classes_by_name = _collect_model(sources, package_root)
+    return _build_edges(classes, classes_by_name)
+
+
+def _build_edges(classes: list[_ClassInfo],
+                 classes_by_name: dict[str, list[_ClassInfo]]
+                 ) -> dict[tuple[str, str], tuple[str, int]]:
     # Locks a method of a class acquires (for call-mediated edges).
     acquires_of: dict[tuple[str, str], set[str]] = {}
     for info in classes:
@@ -331,8 +376,91 @@ def check_locks(sources: Sequence[SourceFile],
             if not isinstance(meth, ast.FunctionDef):
                 continue
             _nested_with_edges(meth, info, add_edge)
+    return edges
 
-    findings.extend(_find_cycles(edges))
+
+def guarded_contracts(sources: Sequence[SourceFile], package_root: str
+                      ) -> dict[str, dict[str, list[str]]]:
+    """``dotted.module.Class -> attr -> sorted lock names``: the KUKE005
+    guarded-attribute sets (inferred + ``# guarded-by:`` declared) in the
+    machine-readable shape both kukelint and kukesan consume. Persisted by
+    ``--write-contracts`` as ``analysis/guarded_by.json``; kukesan's
+    ``__setattr__`` hooks enforce it at runtime."""
+    classes, _ = _collect_model(sources, package_root)
+    out: dict[str, dict[str, list[str]]] = {}
+    rel_to_dotted = {
+        src.rel: _modname(src, package_root) for src in sources}
+    for info in classes:
+        guarded = info.guarded_attrs()
+        if not guarded:
+            continue
+        key = f"{rel_to_dotted[info.module]}.{info.name}"
+        out[key] = {attr: sorted(locks)
+                    for attr, locks in sorted(guarded.items())}
+    return dict(sorted(out.items()))
+
+
+def default_contracts_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "guarded_by.json")
+
+
+def render_contracts(contracts: dict[str, dict[str, list[str]]]) -> str:
+    import json
+
+    return json.dumps(
+        {"version": 1,
+         "comment": "KUKE005 guarded-by contract, generated by "
+                    "`python -m kukeon_tpu.analysis --write-contracts`. "
+                    "Consumed by kukeon_tpu/sanitize (kukesan) __setattr__ "
+                    "hooks under KUKEON_SANITIZE=1. Do not edit by hand: "
+                    "add `# guarded-by:` annotations or locked writes in "
+                    "the source and regenerate.",
+         "classes": contracts},
+        indent=2, sort_keys=True) + "\n"
+
+
+@register_pass(("KUKE005", "KUKE006"))
+def check_locks(sources: Sequence[SourceFile],
+                package_root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    classes, classes_by_name = _collect_model(sources, package_root)
+
+    # --- KUKE005: locked-somewhere means locked-everywhere ---------------
+    for info in classes:
+        ctx = _locked_context_methods(info)
+        locked_attrs: dict[str, set[str]] = {}
+        for w in info.writes:
+            held = w.locks | ctx.get(w.method, frozenset())
+            if held:
+                locked_attrs.setdefault(w.attr, set()).update(held)
+        for attr, locks in info.declared.items():
+            locked_attrs.setdefault(attr, set()).update(locks)
+        for w in info.writes:
+            if w.attr not in locked_attrs:
+                continue
+            if w.attr in info.lock_attrs:
+                continue
+            if any(w.method.startswith(p) for p in INIT_EXEMPT_PREFIXES):
+                continue
+            held = w.locks | ctx.get(w.method, frozenset())
+            if not held:
+                declared = w.attr in info.declared
+                guards = ", ".join(sorted(
+                    f"self.{g}" for g in locked_attrs[w.attr]))
+                why = ("declared `# guarded-by` " if declared
+                       else f"written under {guards} elsewhere ")
+                findings.append(Finding(
+                    "KUKE005", info.module, w.line,
+                    f"self.{w.attr} is {why}"
+                    f"in {info.name} but written without the lock here "
+                    f"({info.name}.{w.method}) — guard this write or "
+                    f"document why the attribute needs no lock at all",
+                    scope=f"{info.name}.{w.method}",
+                    detail=w.attr))
+
+    # --- KUKE006: acquisition-order cycle detection ----------------------
+    findings.extend(_find_cycles(_build_edges(classes, classes_by_name)))
     return findings
 
 

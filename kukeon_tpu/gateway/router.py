@@ -15,6 +15,15 @@ Two inputs, one decision:
 Default policy is least queue depth (gateway-side in-flight counts break
 ties) over the ready set; the affine replica wins when it is ready and not
 excluded by an earlier failed attempt this request.
+
+Thread model: the poll loop writes each replica's snapshot fields
+(``ready``/``draining``/``queue_depth``/``poll_ok``) as plain attributes
+the pick path reads — worst case a pick routes on a snapshot one poll
+stale, which the retry layer above absorbs. The only mutually-written
+field is the in-flight count, guarded by ``_inflight_lock`` (created
+through the kukesan factory and marked *hot*: blocking calls while
+holding it are sanitizer findings — the count must stay a
+nanosecond-scale critical section on the proxy hot path).
 """
 
 from __future__ import annotations
@@ -24,12 +33,16 @@ import json
 import threading
 import time
 import urllib.request
+from typing import FrozenSet, Optional, Set, Union
+
+from kukeon_tpu import sanitize
 
 
+@sanitize.guard_class
 class ReplicaState:
     """One replica's routing view: identity + the last polled snapshot."""
 
-    def __init__(self, name: str, url: str):
+    def __init__(self, name: str, url: str) -> None:
         self.name = name
         self.url = url.rstrip("/")
         self.ready = False
@@ -39,21 +52,22 @@ class ReplicaState:
         self.last_poll_at = 0.0
         # Gateway-side in-flight proxied requests: fresher than the polled
         # queue depth, used as the tiebreaker between equally-deep queues.
-        self.inflight = 0
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = sanitize.lock(
+            "ReplicaState._inflight_lock", hot=True)
+        self.inflight = 0   # guarded-by: _inflight_lock
 
-    def begin(self):
+    def begin(self) -> None:
         with self._inflight_lock:
             self.inflight += 1
 
-    def end(self):
+    def end(self) -> None:
         with self._inflight_lock:
             self.inflight -= 1
 
     def load(self) -> int:
         return self.queue_depth + self.inflight
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> dict[str, object]:
         return {
             "name": self.name,
             "url": self.url,
@@ -70,6 +84,7 @@ POLICY_AFFINITY_FALLBACK = "affinity_fallback"
 POLICY_LEAST_LOADED = "least_loaded"
 
 
+@sanitize.guard_class
 class Router:
     """Replica table + poll loop + pick().
 
@@ -79,13 +94,14 @@ class Router:
     """
 
     def __init__(self, replicas: list[tuple[str, str]], *,
-                 poll_interval_s: float = 0.5, poll_timeout_s: float = 1.0):
+                 poll_interval_s: float = 0.5,
+                 poll_timeout_s: float = 1.0) -> None:
         self.replicas = [ReplicaState(n, u) for n, u in replicas]
         self.by_name = {r.name: r for r in self.replicas}
         self.poll_interval_s = poll_interval_s
         self.poll_timeout_s = poll_timeout_s
-        self._halt = threading.Event()
-        self._thread: threading.Thread | None = None
+        self._halt = sanitize.event("Router._halt")
+        self._thread: Optional[threading.Thread] = None
 
     # --- polling -----------------------------------------------------------
 
@@ -141,9 +157,9 @@ class Router:
         return max(self.replicas, key=lambda r: hashlib.sha256(
             f"{prefix_id}|{r.name}".encode()).digest())
 
-    def pick(self, prefix_id: str | None = None,
-             exclude: frozenset | set = frozenset()
-             ) -> tuple[ReplicaState | None, str | None]:
+    def pick(self, prefix_id: Optional[str] = None,
+             exclude: Union[FrozenSet[str], Set[str]] = frozenset()
+             ) -> tuple[Optional[ReplicaState], Optional[str]]:
         """(replica, policy) — or (None, None) when nothing is routable."""
         policy = POLICY_LEAST_LOADED
         if prefix_id is not None:

@@ -34,6 +34,8 @@ import threading
 import time
 from collections import deque
 
+from kukeon_tpu import sanitize
+
 # memory_stats() key -> exposed family. Every backend that reports memory
 # uses these PJRT names (TPU, GPU); absent keys are simply skipped.
 _HBM_FAMILIES = (
@@ -163,8 +165,8 @@ class ProfileSpool:
                                          "kukeon-profiles"))
         self.keep = max(1, keep if keep is not None
                         else int(os.environ.get(PROFILE_KEEP_ENV, "4") or 4))
-        self._lock = threading.Lock()
-        self._active: dict | None = None
+        self._lock = sanitize.lock("ProfileSpool._lock")
+        self._active: dict | None = None   # guarded-by: _lock
         # Failed captures leave nothing on disk; keep their records so
         # GET /v1/profile can answer "why did my capture vanish".
         self._failed: deque[dict] = deque(maxlen=8)

@@ -27,6 +27,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Iterable, TypeVar, cast
 
+from kukeon_tpu import sanitize
+
 # Fixed log-spaced latency ladder: 0.25ms * 2^i, i in [0, 19) -> ~0.25ms,
 # 0.5ms, 1ms, ... 65.5s, 131s. Wide enough for TTFT on a tunneled chip and
 # tight enough at the bottom for inter-token latency.
@@ -237,7 +239,11 @@ class Registry:
     """A named set of metrics plus scrape-time collectors."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # One lock per registry, shared with every metric it creates
+        # (kukesan proxy under KUKEON_SANITIZE=1 — metric updates inside
+        # other subsystems' critical sections then become lock-graph
+        # edges the static pass cannot see).
+        self._lock: threading.Lock = sanitize.lock("Registry._lock")
         self._metrics: dict[str, _Metric] = {}
         self._collectors: list[Callable[[], Iterable[object]]] = []
         # Scrape-robustness accounting: a gauge callable or collector that

@@ -30,9 +30,10 @@ bucket; the objective is a p95, so the allowed bad fraction is 5%.
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from collections import deque
+
+from kukeon_tpu import sanitize
 
 _BAD_OUTCOMES = ("error", "timeout")
 # The ttft objective is a p95: up to 5% of requests may exceed it.
@@ -93,7 +94,7 @@ class SloTracker:
         self._ttft_name = ttft_histogram
         self._windows = tuple(windows)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("SloTracker._lock")
         self._snaps: deque[_Snapshot] = deque()
         registry.register_collector(self.collect)
 

@@ -21,9 +21,10 @@ span and its log records correlate.
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from collections import deque
+
+from kukeon_tpu import sanitize
 
 # Event-chain order; phase N is the gap between event N and event N+1.
 EVENTS = ("submitted", "admitted", "prefill_dispatched", "first_token",
@@ -89,7 +90,7 @@ class Tracer:
     """Span factory + bounded completed-span buffer (thread-safe)."""
 
     def __init__(self, capacity: int = 512):
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("Tracer._lock")
         self._done: deque[Span] = deque(maxlen=max(1, capacity))
 
     def begin(self, request_id: int, prompt_tokens: int) -> Span:

@@ -21,6 +21,7 @@ import threading
 import time
 import traceback
 
+from kukeon_tpu import sanitize
 from kukeon_tpu.obs import federate as fed
 from kukeon_tpu.obs import percentile_from_counts
 from kukeon_tpu.runtime import consts
@@ -697,7 +698,7 @@ class DaemonServer:
             else self.settings.get("KUKEOND_RECONCILE_INTERVAL")
         )
         self.ctl = build_controller(run_path, self.settings)
-        self._shutdown = threading.Event()
+        self._shutdown = sanitize.event("DaemonServer._shutdown")
         self._server: _ThreadingUnixServer | None = None
 
     def serve(self) -> None:

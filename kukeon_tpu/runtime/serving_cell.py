@@ -49,7 +49,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-from kukeon_tpu import faults
+from kukeon_tpu import faults, sanitize
 from kukeon_tpu.obs import (
     ProfileBusy,
     ProfileSpool,
@@ -74,6 +74,7 @@ WATCHDOG_ENV = "KUKEON_WATCHDOG_S"
 WATCHDOG_PROBE_TIMEOUT_ENV = "KUKEON_WATCHDOG_PROBE_TIMEOUT_S"
 
 
+@sanitize.guard_class
 class LifecycleMixin:
     """Readiness/drain lifecycle shared by both cell flavors.
 
@@ -81,16 +82,25 @@ class LifecycleMixin:
     finishing) -> drained. The watchdog flips unready via mark_unready
     before exiting. Everything here is advisory for direct (non-HTTP) cell
     use; the HTTP handler is where admission is enforced.
+
+    Lock hierarchy: ``_drain_lock`` serializes the drain state machine
+    (``draining`` flips exactly once), ``_inflight_lock`` guards the HTTP
+    in-flight count — they never nest. Under ``KUKEON_SANITIZE=1`` both
+    are kukesan recording proxies and the guarded-by contract below is
+    enforced on every write.
     """
 
     def _init_lifecycle(self):
-        self._ready = threading.Event()
+        self._ready = sanitize.event("LifecycleMixin._ready")
         self.unready_reason: str | None = "warming up"
-        self.draining = False
-        self.drained = threading.Event()
-        self._drain_lock = threading.Lock()
-        self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        # Guarded attrs are assigned BEFORE their locks exist: kukesan's
+        # __setattr__ hook then skips them even when a subclass constructs
+        # without a wrapped __init__ (no guard lock to interrogate yet).
+        self.draining = False   # guarded-by: _drain_lock
+        self._drain_lock = sanitize.lock("LifecycleMixin._drain_lock")
+        self.drained = sanitize.event("LifecycleMixin.drained")
+        self._inflight = 0      # guarded-by: _inflight_lock
+        self._inflight_lock = sanitize.lock("LifecycleMixin._inflight_lock")
         # main() points this at server.shutdown so a finished drain unblocks
         # serve_forever and the process exits 0.
         self.on_drained = None
@@ -285,6 +295,7 @@ def _register_models():
     })
 
 
+@sanitize.guard_class
 class ServingCell(LifecycleMixin):
     def __init__(self, model: str, *, num_slots: int, max_seq_len: int | None,
                  checkpoint: str | None, dtype: str | None, seed: int = 0,
@@ -396,8 +407,8 @@ class ServingCell(LifecycleMixin):
 
         self.tokenizer = load_tokenizer(checkpoint)
         self.started_at = time.time()
-        self.total_tokens = 0
-        self._stats_lock = threading.Lock()
+        self._stats_lock = sanitize.lock("ServingCell._stats_lock")
+        self.total_tokens = 0   # guarded-by: _stats_lock
         # Default per-request deadline; a request's own deadlineS wins.
         self.default_deadline_s = deadline_s
         self._init_lifecycle()
@@ -651,6 +662,7 @@ class ServingCell(LifecycleMixin):
         }
 
 
+@sanitize.guard_class
 class EmbeddingCell(LifecycleMixin):
     """Embedding-model serving cell (bge-base): /v1/embed instead of
     /v1/generate; same health/stats seams as the decoder cell so the
@@ -694,8 +706,8 @@ class EmbeddingCell(LifecycleMixin):
 
         self.tokenizer = load_tokenizer(checkpoint)
         self.started_at = time.time()
-        self.total_sequences = 0
-        self._stats_lock = threading.Lock()
+        self._stats_lock = sanitize.lock("EmbeddingCell._stats_lock")
+        self.total_sequences = 0   # guarded-by: _stats_lock
         self._init_lifecycle()
         self._init_cell_obs(Registry(), kind="embedding")
         self.registry.gauge(
@@ -766,6 +778,7 @@ class EmbeddingCell(LifecycleMixin):
         }
 
 
+@sanitize.guard_class
 class EngineWatchdog(threading.Thread):
     """Detects a wedged TPU runtime behind a stuck engine and gets the cell
     restarted instead of hanging forever.
@@ -799,7 +812,7 @@ class EngineWatchdog(threading.Thread):
         self.tripped = False
         self.last_verdict: tuple[str, str] | None = None
         self.probes = 0
-        self._halt = threading.Event()
+        self._halt = sanitize.event("EngineWatchdog._halt")
         # Watchdog activity on the cell's scrape: every probe is a sign
         # the engine stalled past budget; a trip precedes the exit-86.
         reg = registry if registry is not None else Registry()
@@ -835,7 +848,11 @@ class EngineWatchdog(threading.Thread):
             # Runtime answers: the stall is compute- or host-side. Treat the
             # probe completion as progress so the next probe waits a full
             # budget (no probe hammering during a legitimately long step).
-            self.engine.last_progress = time.monotonic()
+            # Under the engine's admission lock: last_progress is
+            # _lock-guarded state (kukesan surfaced this write as the
+            # tree's one cross-thread unlocked heartbeat write).
+            with self.engine._lock:
+                self.engine.last_progress = time.monotonic()
 
 
 def make_handler(cell: ServingCell):

@@ -1,0 +1,26 @@
+"""CLI: ``python -m kukeon_tpu.sanitize [package_root]`` — print the
+merged runtime/static lock-graph report as JSON.
+
+From a fresh process the runtime side is empty and the report is the
+static KUKE006 graph plus empty diffs; the interesting reports come from
+a live session — the tier-1 conftest writes one to the path in
+``KUKEON_SANITIZE_REPORT`` at the end of a ``KUKEON_SANITIZE=1`` run.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from kukeon_tpu.sanitize.report import merge_report
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    package_root = args[0] if args else None
+    print(json.dumps(merge_report(package_root), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""Guarded-by contract loading: the shared file both analyzers consume.
+
+The contract is KUKE005's inferred (plus ``# guarded-by:``-declared)
+guarded-attribute sets, exported by ``python -m kukeon_tpu.analysis
+--write-contracts`` into ``kukeon_tpu/analysis/guarded_by.json`` and
+checked into the tree (a tier-1 drift guard regenerates and compares it).
+kukelint recomputes the sets from source on every run — the file exists
+for consumers that must not pay an AST pass at import time: kukesan's
+``__setattr__`` hooks look classes up here by ``module.Class`` key.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any
+
+_load_lock = threading.Lock()
+_cache: dict[str, dict[str, tuple[str, ...]]] | None = None
+
+
+def contracts_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "analysis", "guarded_by.json")
+
+
+def load() -> dict[str, dict[str, tuple[str, ...]]]:
+    """The parsed contract, cached for the process (``module.Class ->
+    attr -> lock names``). Missing/unreadable file = empty contract: the
+    sanitizer degrades to lock-order + blocking checks rather than
+    failing imports."""
+    global _cache
+    with _load_lock:
+        if _cache is not None:
+            return _cache
+        out: dict[str, dict[str, tuple[str, ...]]] = {}
+        try:
+            with open(contracts_path(), encoding="utf-8") as f:
+                data: Any = json.load(f)
+            for key, attrs in data.get("classes", {}).items():
+                out[key] = {attr: tuple(locks)
+                            for attr, locks in attrs.items()}
+        except (OSError, ValueError):
+            out = {}
+        _cache = out
+        return out
+
+
+def for_class(cls: type) -> dict[str, tuple[str, ...]]:
+    """This class's own contract entry (callers merge over the MRO)."""
+    return load().get(f"{cls.__module__}.{cls.__qualname__}", {})
+
+
+def _reset_for_tests() -> None:
+    global _cache
+    with _load_lock:
+        _cache = None

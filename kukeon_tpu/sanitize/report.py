@@ -1,0 +1,63 @@
+"""Merging the runtime-observed lock-order graph into KUKE006's static one.
+
+Both analyzers name locks identically (``path/to/file.py:Class.attr`` —
+the sanitize factory derives the prefix from the creating frame, the
+static pass from the scanned file), so their edge sets diff directly:
+
+- **runtime-only edges** are acquisitions the AST pass could not resolve
+  (locks reached through callbacks, dynamically started threads,
+  cross-module chains through untyped attributes) — exactly the blind
+  spots kukelint's own docs list. Each carries the witness stacks.
+- **static-only edges** are orderings the suite never exercised this run
+  — a coverage signal, not a bug.
+
+The tier-1 conftest writes this report to ``KUKEON_SANITIZE_REPORT``
+(when set) at the end of a ``KUKEON_SANITIZE=1`` session;
+``python -m kukeon_tpu.sanitize`` prints it for the current process.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kukeon_tpu.sanitize import runtime as _rt
+
+
+def merge_report(package_root: str | None = None) -> dict[str, Any]:
+    """One JSON-able document diffing the runtime graph against the
+    static KUKE006 graph of ``package_root`` (default: the installed
+    kukeon_tpu package)."""
+    import os
+
+    from kukeon_tpu.analysis.core import load_sources
+    from kukeon_tpu.analysis.locks import build_lock_graph
+
+    if package_root is None:
+        package_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+    static = build_lock_graph(load_sources(package_root), package_root)
+    observed = _rt.observed_edges()
+    static_keys = set(static)
+    runtime_keys = set(observed)
+    runtime_only = sorted(runtime_keys - static_keys)
+    static_only = sorted(static_keys - runtime_keys)
+    shared = sorted(static_keys & runtime_keys)
+    return {
+        "version": 1,
+        "tool": "kukesan",
+        "static_edges": len(static_keys),
+        "runtime_edges": len(runtime_keys),
+        "shared": [{"from": a, "to": b} for a, b in shared],
+        "runtime_only": [
+            {"from": a, "to": b,
+             "held_at": observed[(a, b)][0],
+             "acquired_at": observed[(a, b)][1]}
+            for a, b in runtime_only
+        ],
+        "static_only": [
+            {"from": a, "to": b,
+             "file": static[(a, b)][0], "line": static[(a, b)][1]}
+            for a, b in static_only
+        ],
+        "findings": [f.to_dict() for f in _rt.findings()],
+    }

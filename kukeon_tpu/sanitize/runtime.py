@@ -1,0 +1,617 @@
+"""kukesan core: recording lock proxies, held-sets, the runtime lock-order
+graph, guarded-by ``__setattr__`` hooks, and blocking-call hazards.
+
+Everything here is stdlib-only and import-light: obs/registry.py and the
+analysis package import this module, so it must never pull in jax (or
+anything heavy). All sanitizer state is process-global on purpose — the
+lock-order graph accumulates across every engine/router/cell a test
+session constructs, which is exactly what makes cross-module cycles
+observable.
+
+Internal synchronization uses a RAW ``threading.Lock`` (``_state_lock``):
+the sanitizer must never trace itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import threading
+import time as _time
+import urllib.request
+from typing import Any, Callable
+
+ENV = "KUKEON_SANITIZE"
+SLEEP_THRESHOLD_ENV = "KUKEON_SANITIZE_SLEEP_S"
+_DEFAULT_SLEEP_THRESHOLD_S = 0.01
+_STACK_DEPTH = 16
+
+RULE_IDS = {
+    "lock-order-cycle": "KUKESAN001",
+    "unguarded-write": "KUKESAN002",
+    "blocking-under-lock": "KUKESAN003",
+}
+
+
+def enabled() -> bool:
+    """True when KUKEON_SANITIZE asks for recording proxies. Checked at
+    *creation* time of every primitive, so a process (or a single test
+    via monkeypatch.setenv) opts in before constructing the objects it
+    wants sanitized."""
+    return os.environ.get(ENV, "").lower() in ("1", "true", "yes", "on")
+
+
+class SanitizerError(RuntimeError):
+    """A fail-hard sanitizer verdict (observed lock-order cycle)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SanFinding:
+    """One recorded sanitizer finding, with stack provenance."""
+
+    kind: str                         # key into RULE_IDS
+    message: str
+    stacks: tuple[tuple[str, str], ...]   # (label, formatted stack)
+
+    @property
+    def rule(self) -> str:
+        return RULE_IDS.get(self.kind, "KUKESAN000")
+
+    def render(self) -> str:
+        parts = [f"{self.rule} [{self.kind}] {self.message}"]
+        for label, stack in self.stacks:
+            parts.append(f"--- {label} ---")
+            parts.append(stack)
+        return "\n".join(parts)
+
+    def to_dict(self) -> dict[str, Any]:
+        """The same shape ``python -m kukeon_tpu.analysis --format json``
+        emits for static findings, so one consumer reads both reports."""
+        return {
+            "id": f"{self.rule}:{self.message}",
+            "rule": self.rule,
+            "kind": self.kind,
+            "message": self.message,
+            "stacks": {label: stack for label, stack in self.stacks},
+        }
+
+
+# --- process-global sanitizer state ------------------------------------------
+
+_state_lock = threading.Lock()          # raw: guards everything below
+_findings: list[SanFinding] = []
+# (held-name, acquired-name) -> (held's acquire stack, acquirer stack)
+_edges: dict[tuple[str, str], tuple[str, str]] = {}
+_adj: dict[str, set[str]] = {}
+_active = False                          # flips True on first proxy creation
+_orig_sleep: Callable[[float], None] | None = None
+_orig_urlopen: Callable[..., Any] | None = None
+
+_tls = threading.local()
+
+
+class _Held:
+    """One sanitized lock the current thread holds (plus where)."""
+
+    __slots__ = ("lock", "stack", "count")
+
+    def __init__(self, lock: "_SanLockBase", stack: str) -> None:
+        self.lock = lock
+        self.stack = stack
+        self.count = 1
+
+
+def _held_list() -> list[_Held]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = []
+        _tls.held = held
+    return held
+
+
+def _sleep_threshold() -> float:
+    raw = os.environ.get(SLEEP_THRESHOLD_ENV, "")
+    try:
+        return float(raw) if raw else _DEFAULT_SLEEP_THRESHOLD_S
+    except ValueError:
+        return _DEFAULT_SLEEP_THRESHOLD_S
+
+
+def _capture_stack(skip: int = 2) -> str:
+    """Compact stack summary (most recent call last), skipping sanitizer
+    frames. Deliberately avoids ``traceback`` + linecache I/O: this runs
+    on every sanitized acquire."""
+    frames: list[str] = []
+    try:
+        f = sys._getframe(skip)
+    except ValueError:
+        return "<no stack>"
+    own = os.path.abspath(__file__)
+    depth = 0
+    while f is not None and depth < _STACK_DEPTH:
+        code = f.f_code
+        if os.path.abspath(code.co_filename) != own:
+            frames.append(
+                f"  {_shorten(code.co_filename)}:{f.f_lineno} "
+                f"in {code.co_qualname if hasattr(code, 'co_qualname') else code.co_name}")
+            depth += 1
+        f = f.f_back
+    frames.reverse()
+    return "\n".join(frames) if frames else "<no stack>"
+
+
+def _shorten(filename: str) -> str:
+    """Repo-relative path when the file lives under the package tree (the
+    same ids the static analyzer uses), basename otherwise."""
+    norm = filename.replace(os.sep, "/")
+    i = norm.rfind("kukeon_tpu/")
+    if i >= 0:
+        return norm[i:]
+    j = norm.rfind("tests/")
+    if j >= 0:
+        return norm[j:]
+    return os.path.basename(filename)
+
+
+def _qualify(name: str, depth: int = 2) -> str:
+    """``caller-file.py:Name`` — the same id scheme the static KUKE006
+    graph uses (``kukeon_tpu/serving/engine.py:ServingEngine._lock``), so
+    runtime and static edges merge by exact name."""
+    try:
+        f = sys._getframe(depth)
+        return f"{_shorten(f.f_code.co_filename)}:{name}"
+    except ValueError:
+        return name
+
+
+def _add_finding(finding: SanFinding) -> None:
+    with _state_lock:
+        _findings.append(finding)
+
+
+def findings() -> list[SanFinding]:
+    """Snapshot of the recorded findings (not cleared)."""
+    with _state_lock:
+        return list(_findings)
+
+
+def drain_findings() -> list[SanFinding]:
+    """Return AND clear the recorded findings — the per-test conftest gate
+    uses this so each test answers only for its own violations."""
+    with _state_lock:
+        out = list(_findings)
+        _findings.clear()
+    return out
+
+
+def observed_edges() -> dict[tuple[str, str], tuple[str, str]]:
+    """The runtime lock-order graph observed so far:
+    ``(held, acquired) -> (held's acquire stack, acquirer stack)``."""
+    with _state_lock:
+        return dict(_edges)
+
+
+def _reset_for_tests() -> None:
+    """Clear findings AND the lock-order graph (fixture tests seed
+    deliberate cycles that must not leak into later tests' graphs)."""
+    with _state_lock:
+        _findings.clear()
+        _edges.clear()
+        _adj.clear()
+
+
+# --- blocking-call hazards ---------------------------------------------------
+
+
+def _hot_held() -> list[_Held]:
+    return [h for h in _held_list() if h.lock.hot]
+
+
+def _check_blocking(what: str, duration_s: float | None) -> None:
+    """Record a KUKESAN003 hazard when a blocking call runs while the
+    thread holds a hot lock. ``duration_s`` None means unbounded."""
+    if duration_s is not None and duration_s < _sleep_threshold():
+        return
+    hot = _hot_held()
+    if not hot:
+        return
+    names = ", ".join(h.lock.name for h in hot)
+    stacks: list[tuple[str, str]] = [("blocking call", _capture_stack(3))]
+    for h in hot:
+        stacks.append((f"{h.lock.name} acquired at", h.stack))
+    dur = "unbounded" if duration_s is None else f"{duration_s:g}s"
+    _add_finding(SanFinding(
+        "blocking-under-lock",
+        f"{what} ({dur}) executed while holding hot lock(s) {names} — "
+        f"every other thread contending for the lock stalls for the "
+        f"whole call; move the blocking work outside the critical "
+        f"section",
+        tuple(stacks)))
+
+
+def blocking(what: str, duration_s: float | None = None) -> None:
+    """Explicit blocking-call seam for sites the patches cannot see (the
+    engine's ``_fetch``/``_upload`` device transfers). No-op until the
+    sanitizer is active, and free of any allocation when no hot lock is
+    held."""
+    if not _active:
+        return
+    _check_blocking(what, duration_s)
+
+
+def _patched_sleep(seconds: float) -> None:
+    assert _orig_sleep is not None
+    try:
+        dur: float | None = float(seconds)
+    except (TypeError, ValueError):
+        dur = None
+    _check_blocking("time.sleep", dur)
+    _orig_sleep(seconds)
+
+
+def _patched_urlopen(*args: Any, **kwargs: Any) -> Any:
+    assert _orig_urlopen is not None
+    _check_blocking("urllib.request.urlopen", None)
+    return _orig_urlopen(*args, **kwargs)
+
+
+def _activate() -> None:
+    """Arm the process-wide hooks once (first sanitized primitive): the
+    ``time.sleep`` / ``urlopen`` wrappers only *inspect the thread-local
+    held-set*, so they are inert for code that holds no sanitized lock."""
+    global _active, _orig_sleep, _orig_urlopen
+    with _state_lock:
+        if _active:
+            return
+        _orig_sleep = _time.sleep
+        _time.sleep = _patched_sleep  # type: ignore[assignment]
+        _orig_urlopen = urllib.request.urlopen
+        urllib.request.urlopen = _patched_urlopen  # type: ignore[assignment]
+        _active = True
+
+
+# --- lock-order graph --------------------------------------------------------
+
+
+def _find_path(start: str, goal: str) -> list[str] | None:
+    """A node path start..goal over ``_adj`` (caller holds _state_lock)."""
+    stack: list[list[str]] = [[start]]
+    seen = {start}
+    while stack:
+        path = stack.pop()
+        node = path[-1]
+        if node == goal:
+            return path
+        for nxt in _adj.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(path + [nxt])
+    return None
+
+
+def _record_edge(held: _Held, lock: "_SanLockBase", stack: str) -> None:
+    a, b = held.lock.name, lock.name
+    with _state_lock:
+        if (a, b) in _edges:
+            return
+        _edges[(a, b)] = (held.stack, stack)
+        _adj.setdefault(a, set()).add(b)
+        # Does the new edge close a cycle? Any path b -> … -> a does.
+        path = _find_path(b, a)
+        if path is None:
+            return
+        cycle = [a] + path            # a -> b -> … -> a (path closes it)
+        stacks: list[tuple[str, str]] = []
+        for i in range(len(cycle) - 1):
+            sa, sb = _edges[(cycle[i], cycle[i + 1])]
+            stacks.append((f"{cycle[i]} held at", sa))
+            stacks.append((f"{cycle[i + 1]} acquired at", sb))
+        chain = " -> ".join(cycle)
+        finding = SanFinding(
+            "lock-order-cycle",
+            f"observed lock acquisition-order cycle (deadlock when the "
+            f"acquisitions interleave): {chain}",
+            tuple(stacks))
+        _findings.append(finding)
+    raise SanitizerError(finding.render())
+
+
+def _note_acquired(lock: "_SanLockBase") -> None:
+    """Track an acquire: edges from every currently-held lock, then join
+    the held-set. A cycle verdict raises OUT of the caller's acquire —
+    the caller releases the raw lock first, so the fail-hard path leaves
+    no orphaned held primitive behind."""
+    held = _held_list()
+    stack = _capture_stack(3)
+    for h in held:
+        if h.lock.name != lock.name:
+            _record_edge(h, lock, stack)
+    held.append(_Held(lock, stack))
+
+
+def _note_released(lock: "_SanLockBase") -> None:
+    held = _held_list()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i].lock is lock:
+            del held[i]
+            return
+
+
+# --- recording proxies -------------------------------------------------------
+
+
+class _SanLockBase:
+    """Shared proxy surface over a raw primitive. The ``name`` is the
+    static-analyzer-compatible lock id; ``hot`` marks locks that must
+    never be held across blocking calls (KUKESAN003)."""
+
+    def __init__(self, inner: Any, name: str, hot: bool) -> None:
+        self._inner = inner
+        self.name = name
+        self.hot = hot
+
+    def locked(self) -> bool:
+        return bool(self._inner.locked())
+
+    def _is_owned(self) -> bool:
+        """Owner check (adopted by threading.Condition): exact, from the
+        thread-local held-set — no acquire(0) probing."""
+        return any(h.lock is self for h in _held_list())
+
+    held_by_me = _is_owned
+
+    def __repr__(self) -> str:
+        return f"<kukesan {type(self).__name__} {self.name!r} hot={self.hot}>"
+
+
+class _SanLock(_SanLockBase):
+    """Recording proxy over ``threading.Lock``."""
+
+    def __init__(self, name: str, hot: bool) -> None:
+        super().__init__(threading.Lock(), name, hot)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = bool(self._inner.acquire(blocking, timeout))
+        if got:
+            try:
+                _note_acquired(self)
+            except SanitizerError:
+                self._inner.release()
+                raise
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _note_released(self)
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: object) -> None:
+        self.release()
+
+
+class _SanRLock(_SanLockBase):
+    """Recording proxy over ``threading.RLock`` (held-set entry counted,
+    edges recorded on the outermost acquire only)."""
+
+    def __init__(self, name: str, hot: bool) -> None:
+        super().__init__(threading.RLock(), name, hot)
+
+    def _entry(self) -> _Held | None:
+        for h in _held_list():
+            if h.lock is self:
+                return h
+        return None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = bool(self._inner.acquire(blocking, timeout))
+        if got:
+            e = self._entry()
+            if e is not None:
+                e.count += 1
+            else:
+                try:
+                    _note_acquired(self)
+                except SanitizerError:
+                    self._inner.release()
+                    raise
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        e = self._entry()
+        if e is not None:
+            e.count -= 1
+            if e.count <= 0:
+                _note_released(self)
+
+    def locked(self) -> bool:
+        return self._entry() is not None
+
+    def _is_owned(self) -> bool:
+        return self._entry() is not None
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: object) -> None:
+        self.release()
+
+
+class _SanEvent:
+    """Recording proxy over ``threading.Event``: an untimed (or
+    above-threshold) ``wait`` while holding a hot lock is a blocking
+    hazard — the classic shape of the watchdog-waits-on-the-engine
+    deadlock."""
+
+    def __init__(self, name: str) -> None:
+        self._inner = threading.Event()
+        self.name = name
+
+    def is_set(self) -> bool:
+        return self._inner.is_set()
+
+    def set(self) -> None:
+        self._inner.set()
+
+    def clear(self) -> None:
+        self._inner.clear()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        if not self._inner.is_set():
+            _check_blocking(f"Event.wait({self.name})", timeout)
+        return self._inner.wait(timeout)
+
+    def __repr__(self) -> str:
+        return f"<kukesan Event {self.name!r}>"
+
+
+# --- factories ---------------------------------------------------------------
+
+
+def lock(name: str, *, hot: bool = False) -> Any:
+    """A ``threading.Lock`` — or, under KUKEON_SANITIZE=1, a recording
+    proxy named ``caller-file.py:name`` so runtime edges merge with the
+    static KUKE006 graph. ``hot=True`` additionally forbids blocking
+    calls while held (KUKESAN003)."""
+    if not enabled():
+        return threading.Lock()
+    _activate()
+    return _SanLock(_qualify(name), hot)
+
+
+def rlock(name: str, *, hot: bool = False) -> Any:
+    """``threading.RLock``, same contract as :func:`lock`."""
+    if not enabled():
+        return threading.RLock()
+    _activate()
+    return _SanRLock(_qualify(name), hot)
+
+
+def condition(lock_obj: Any = None, *, name: str = "condition") -> Any:
+    """``threading.Condition`` over a (possibly sanitized) lock. Tracking
+    lives entirely in the lock proxy — ``Condition`` adopts its
+    ``acquire``/``release``/``_is_owned``, so ``wait()`` correctly drops
+    and re-records the held entry."""
+    if lock_obj is None and enabled():
+        _activate()
+        lock_obj = _SanLock(_qualify(name), False)
+    return threading.Condition(lock_obj)
+
+
+def event(name: str) -> Any:
+    """``threading.Event`` — or a proxy flagging hot-lock-held waits."""
+    if not enabled():
+        return threading.Event()
+    _activate()
+    return _SanEvent(_qualify(name))
+
+
+# --- guarded-by enforcement --------------------------------------------------
+
+_guards_cache: dict[type, dict[str, tuple[str, ...]]] = {}
+
+
+def _class_guards(cls: type) -> dict[str, tuple[str, ...]]:
+    """attr -> candidate lock attr names, merged over the MRO (base-class
+    contracts apply to subclass instances) and cached per class."""
+    cached = _guards_cache.get(cls)
+    if cached is not None:
+        return cached
+    from kukeon_tpu.sanitize import contracts
+
+    merged: dict[str, tuple[str, ...]] = {}
+    for c in reversed(cls.__mro__):
+        explicit = c.__dict__.get("__san_contract__")
+        if explicit:
+            for attr, locks in explicit.items():
+                merged[attr] = tuple(locks)
+        from_file = contracts.for_class(c)
+        for attr, locks in from_file.items():
+            merged[attr] = tuple(locks)
+    with _state_lock:
+        _guards_cache[cls] = merged
+    return merged
+
+
+def _check_guarded(obj: Any, attr: str, lock_names: tuple[str, ...]) -> None:
+    verifiable = False
+    for ln in lock_names:
+        lk = obj.__dict__.get(ln)
+        if isinstance(lk, _SanLockBase):
+            verifiable = True
+            if lk._is_owned():
+                return
+    if not verifiable:
+        # The guard lock does not exist yet (object mid-construction
+        # without a wrapped __init__) or is a raw primitive we cannot
+        # interrogate: no verdict either way.
+        return
+    want = ", ".join(f"self.{n}" for n in lock_names)
+    _add_finding(SanFinding(
+        "unguarded-write",
+        f"{type(obj).__name__}.{attr} is guarded by {want} (KUKE005 "
+        f"contract) but written without the lock held",
+        (("write at", _capture_stack(3)),)))
+
+
+def guard_class(cls: type | None = None, *,
+                contract: dict[str, tuple[str, ...]] | None = None) -> Any:
+    """Class decorator opting a class into runtime guarded-by checks.
+
+    Unarmed: returns the class untouched (zero overhead). Armed: installs
+    a ``__setattr__`` hook validating every attribute rebind against the
+    class's contract — the KUKE005 export in ``analysis/guarded_by.json``
+    by default, or the explicit ``contract={attr: (lock_attr, …)}``
+    mapping (fixture tests, classes outside the scanned package). The
+    class's own ``__init__`` (and everything it calls) is exempt via a
+    dynamic-extent depth flag, mirroring the static rule's constructor
+    exemption."""
+
+    def deco(klass: type) -> type:
+        if not enabled():
+            return klass
+        _activate()
+        if contract:
+            klass.__san_contract__ = {                 # type: ignore[attr-defined]
+                attr: tuple(locks) for attr, locks in contract.items()}
+        _guards_cache.pop(klass, None)
+
+        init = klass.__dict__.get("__init__")
+        if init is not None and not getattr(init, "_san_wrapped", False):
+            def wrapped_init(self: Any, *a: Any, **kw: Any) -> None:
+                d = self.__dict__
+                d["_san_init_depth"] = d.get("_san_init_depth", 0) + 1
+                try:
+                    init(self, *a, **kw)
+                finally:
+                    d["_san_init_depth"] -= 1
+            wrapped_init._san_wrapped = True           # type: ignore[attr-defined]
+            wrapped_init.__name__ = "__init__"
+            wrapped_init.__qualname__ = getattr(init, "__qualname__",
+                                                "__init__")
+            klass.__init__ = wrapped_init              # type: ignore[misc]
+
+        # Install the checking __setattr__ unless an ancestor's hook is
+        # already inherited (double-decorating a hierarchy must not stack
+        # two checks per write).
+        current = klass.__setattr__
+        if not getattr(current, "_san_wrapped", False):
+            orig_setattr = current
+
+            def checking_setattr(self: Any, name: str, value: Any) -> None:
+                guards = _class_guards(type(self))
+                g = guards.get(name)
+                if g is not None and not self.__dict__.get("_san_init_depth"):
+                    _check_guarded(self, name, g)
+                orig_setattr(self, name, value)
+
+            checking_setattr._san_wrapped = True       # type: ignore[attr-defined]
+            klass.__setattr__ = checking_setattr       # type: ignore[misc, assignment]
+        return klass
+
+    if cls is not None:
+        return deco(cls)
+    return deco

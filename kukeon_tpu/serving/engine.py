@@ -63,7 +63,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from kukeon_tpu import faults
+from kukeon_tpu import faults, sanitize
 from kukeon_tpu.models import llama
 from kukeon_tpu.serving.kv_pages import (
     SCRATCH_PAGE,
@@ -222,12 +222,19 @@ def bucket_length(n: int, buckets: tuple[int, ...] = PREFILL_BUCKETS) -> int:
     return ((n + last - 1) // last) * last
 
 
+@sanitize.guard_class
 class ServingEngine:
     """Slot-based continuous-batching engine over a jitted Llama.
 
     Thread model: callers enqueue via :meth:`submit`; a single engine thread
     (or the caller via :meth:`step`) drives prefill+decode. One engine owns
-    its params/cache; run one engine per model cell.
+    its params/cache; run one engine per model cell. ``_lock`` guards the
+    admission state (``_pending_n``/``_next_id``/``_requests``/
+    ``last_progress``/``_running``) and doubles as the ``_work`` condition's
+    lock — the engine loop sleeps on ``_work`` when idle and submit/stop
+    notify it. Under ``KUKEON_SANITIZE=1`` the lock is a kukesan recording
+    proxy (hot: blocking calls while holding it are findings) and this
+    class's guarded-by contract is enforced on every attribute write.
     """
 
     def __init__(
@@ -406,7 +413,7 @@ class ServingEngine:
             params, self._shardings,
         )
         self._load_exc: Exception | None = None
-        self._loaded = threading.Event()
+        self._loaded = sanitize.event("ServingEngine._loaded")
         if async_load:
             # Weight transfer off-thread so cold start can overlap it with
             # precompile(): the boot pays max(transfer, compile), not the
@@ -464,9 +471,15 @@ class ServingEngine:
 
         self._resume: "Any" = _deque()
         self._pending: queue.Queue[Request] = queue.Queue()
-        self._next_id = 0
-        self._lock = threading.Lock()
-        self._running = False
+        self._next_id = 0   # guarded-by: _lock
+        self._lock = sanitize.lock("ServingEngine._lock", hot=True)
+        # Work signal for the engine loop: notified on submit and stop so
+        # the idle loop wakes immediately instead of sleep-polling
+        # (KUKE009). Shares _lock — the predicate it waits on
+        # (_pending_n, slot occupancy) is _lock-guarded state.
+        self._work = sanitize.condition(self._lock,
+                                        name="ServingEngine._work")
+        self._running = False   # guarded-by: _lock
         self._thread: threading.Thread | None = None
         self.error: Exception | None = None   # last engine-loop failure
         # Admission control: with max_pending set, submit() sheds (raises
@@ -476,7 +489,7 @@ class ServingEngine:
         # _pending_n is the exact count of admitted-not-yet-slotted requests
         # (queue.qsize() is wrong during the sweep's drain-and-refill).
         self.max_pending = max_pending
-        self._pending_n = 0
+        self._pending_n = 0   # guarded-by: _lock
         self.retry_after_s = 1.0
 
         # --- observability (obs/) -------------------------------------
@@ -565,7 +578,7 @@ class ServingEngine:
         # every step() that did work. A wedged runtime blocks the driver
         # inside a device call, so this goes stale while work is queued —
         # exactly the signal stalled_s() exposes.
-        self.last_progress = time.monotonic()
+        self.last_progress = time.monotonic()   # guarded-by: _lock
 
         # Prefix cache: prefix_id -> stored prompt KV (LRU, driver-thread
         # only). Agent sessions re-send a large shared/growing context with
@@ -955,6 +968,7 @@ class ServingEngine:
         budget is ≤1 per decode chunk — tests/test_serving.py asserts it
         here)."""
         faults.maybe_fail("engine.fetch")
+        sanitize.blocking("engine._fetch device transfer")
         t0 = time.monotonic()
         out = np.asarray(x)
         self.sync_stats["fetches"] += 1
@@ -964,6 +978,7 @@ class ServingEngine:
     def _upload(self, x):
         """Host→device array upload, counted and timed."""
         faults.maybe_fail("engine.upload")
+        sanitize.blocking("engine._upload device transfer")
         t0 = time.monotonic()
         out = jnp.asarray(x)
         self.sync_stats["uploads"] += 1
@@ -1180,6 +1195,9 @@ class ServingEngine:
             )
         req.trace = self.tracer.begin(req.id, int(prompt.size))
         self._pending.put(req)
+        with self._lock:
+            # Wake an idle engine loop parked on the work condition.
+            self._work.notify()
         return req
 
     @property
@@ -1255,23 +1273,46 @@ class ServingEngine:
 
     def start(self):
         """Run the engine loop on a background thread."""
-        self._running = True
+        with self._lock:
+            self._running = True
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="serving-engine"
         )
         self._thread.start()
 
     def stop(self):
-        self._running = False
+        with self._lock:
+            self._running = False
+            # Wake an idle loop parked on the work condition NOW; without
+            # the notify it would only notice _running on the safety-net
+            # wait timeout.
+            self._work.notify_all()
         if self._thread:
             self._thread.join(timeout=5)
             self._thread = None
+
+    def _idle_locked(self) -> bool:
+        """True when the loop has nothing to do (caller holds _lock):
+        no admitted-unslotted requests, no preempted requests parked for
+        resume, no active slots, no unflushed inflight chunk. Cancelled
+        or expiring queued requests keep _pending_n nonzero until swept,
+        so the loop never parks while any request still needs a sweep."""
+        return (self._pending_n == 0 and not self._resume
+                and self._inflight is None
+                and all(r is None for r in self._slot_req))
 
     def _loop(self):
         while self._running:
             try:
                 if not self.step():
-                    time.sleep(0.001)
+                    # Idle: park on the work condition instead of
+                    # sleep-polling (KUKE009). submit()/stop() notify; the
+                    # timeout is a safety net for wake paths that predate
+                    # the signal (nothing correctness-bearing relies on
+                    # it — a lost notify only costs one timeout).
+                    with self._work:
+                        if self._running and self._idle_locked():
+                            self._work.wait(timeout=0.05)
             except Exception as e:  # noqa: BLE001 — the engine thread must not die silently
                 import traceback
 
@@ -1298,7 +1339,8 @@ class ServingEngine:
                         self._bt_dirty = True
                         self._prefix_cache.clear()
                 except Exception:  # noqa: BLE001
-                    self._running = False
+                    with self._lock:
+                        self._running = False
                     raise
 
     def _fail_request(self, req: Request, exc: Exception) -> None:

@@ -45,6 +45,57 @@ def _isolate_profile_spool(tmp_path, monkeypatch):
     monkeypatch.setenv("KUKEON_PROFILE_DIR", str(tmp_path / "profiles"))
 
 
+_SANITIZE_SESSION = False   # KUKEON_SANITIZE was set when the session began
+
+
+def pytest_sessionstart(session):
+    """Latch the sanitizer opt-in at session start: individual tests
+    monkeypatch KUKEON_SANITIZE for their fixtures, and the per-test gate
+    below must key off the *session-level* opt-in, not whatever a test
+    left in the environment."""
+    global _SANITIZE_SESSION
+    from kukeon_tpu import sanitize
+
+    _SANITIZE_SESSION = sanitize.enabled()
+
+
+@pytest.fixture(autouse=True)
+def _sanitize_findings_gate():
+    """kukesan per-test gate: under a KUKEON_SANITIZE=1 session, any
+    sanitizer finding a test produced (unguarded write to lock-guarded
+    state, blocking call under a hot lock, observed lock-order cycle)
+    fails THAT test with the recorded stacks. Findings are drained either
+    way so fixture tests that deliberately provoke them stay isolated."""
+    from kukeon_tpu import sanitize
+
+    leftover = sanitize.drain_findings()
+    yield
+    found = sanitize.drain_findings()
+    if _SANITIZE_SESSION:
+        if leftover:
+            # Produced between tests (teardown threads of an earlier
+            # test): surface rather than silently blaming nobody.
+            found = leftover + found
+        assert not found, (
+            "kukesan findings:\n\n"
+            + "\n\n".join(f.render() for f in found))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Close the static/dynamic loop: at the end of a sanitized session,
+    write the merged lock-graph report (runtime-observed edges vs the
+    KUKE006 static graph) to KUKEON_SANITIZE_REPORT when set."""
+    out = os.environ.get("KUKEON_SANITIZE_REPORT")
+    if not out or not _SANITIZE_SESSION:
+        return
+    import json
+
+    from kukeon_tpu import sanitize
+
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(sanitize.merge_report(), f, indent=2)
+
+
 @pytest.fixture(autouse=True)
 def _isolate_faults():
     """Guarantee KUKEON_FAULTS never leaks between tests: an armed fault

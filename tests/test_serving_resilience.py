@@ -181,6 +181,9 @@ class _StalledEngine:
 
     def __init__(self, busy=True):
         self.busy = busy
+        # Same guarded heartbeat shape as the real engine: the watchdog
+        # re-arms the heartbeat under this lock on a healthy probe.
+        self._lock = threading.Lock()
         self.last_progress = time.monotonic()
 
     def stalled_s(self) -> float:

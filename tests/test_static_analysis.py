@@ -326,6 +326,102 @@ def test_kuke006_silent_on_consistent_order(tmp_path):
     assert run_analysis(pkg, select=["KUKE006"]) == []
 
 
+def test_kuke005_recognizes_sanitize_factory_locks(tmp_path):
+    """Locks created through the kukesan factory (sanitize.lock) are
+    first-class lock attributes for the static pass too."""
+    pkg = _mini_repo(tmp_path, {"runtime/thing.py": '''
+        from kukeon_tpu import sanitize
+
+
+        class Engine:
+            def __init__(self):
+                self._mtx = sanitize.lock("Engine._mtx")
+                self.depth = 0
+
+            def locked_bump(self):
+                with self._mtx:
+                    self.depth += 1
+
+            def racy(self):
+                self.depth = 5
+    '''})
+    found = run_analysis(pkg, select=["KUKE005"])
+    assert [(f.rule, f.detail) for f in found] == [("KUKE005", "depth")]
+
+
+def test_kuke005_guarded_by_annotation_declares_contract(tmp_path):
+    """An explicit ``# guarded-by:`` comment binds the attribute even when
+    no locked write exists for inference to learn from — the declared
+    attr's unlocked writes become findings."""
+    pkg = _mini_repo(tmp_path, {"runtime/thing.py": '''
+        import threading
+
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.beat = 0.0   # guarded-by: _lock
+
+            def racy(self):
+                self.beat = 1.0
+
+            def fine(self):
+                with self._lock:
+                    self.beat = 2.0
+    '''})
+    found = run_analysis(pkg, select=["KUKE005"])
+    assert [(f.scope, f.detail) for f in found] == [("Engine.racy", "beat")]
+    assert "guarded-by" in found[0].message
+
+
+# --- KUKE009: sub-10ms sleep-polling loops -----------------------------------
+
+
+def test_kuke009_flags_sub10ms_sleep_loop(tmp_path):
+    pkg = _mini_repo(tmp_path, {"serving/engine.py": '''
+        import time
+
+        TICK = 0.002
+
+
+        class Engine:
+            def _loop(self):
+                while self._running:
+                    if not self.step():
+                        time.sleep(0.001)
+
+            def _loop2(self):
+                for _ in range(10):
+                    time.sleep(TICK)   # module constant resolves too
+    '''})
+    found = run_analysis(pkg, select=["KUKE009"])
+    assert sorted(f.detail for f in found) == ["sleep:0.001", "sleep:0.002"]
+    assert {f.scope for f in found} == {"Engine._loop", "Engine._loop2"}
+
+
+def test_kuke009_allows_slow_polls_and_non_loop_sleeps(tmp_path):
+    pkg = _mini_repo(tmp_path, {"serving/engine.py": '''
+        import time
+
+
+        class Engine:
+            def drain(self):
+                while self.busy():
+                    time.sleep(0.05)      # >= 10ms poll: acceptable
+
+            def pause(self):
+                time.sleep(0.001)         # not in a loop
+
+            def spawn(self):
+                while True:
+                    def later():
+                        time.sleep(0.001)  # nested def: not loop-body work
+                    self.submit(later)
+                    break
+    '''})
+    assert run_analysis(pkg, select=["KUKE009"]) == []
+
+
 # --- KUKE007: fault-point registry -------------------------------------------
 
 FAULTS_FIXTURE = '''
@@ -469,8 +565,103 @@ def test_cli_baseline_modes(tmp_path, capsys):
 def test_all_rules_are_registered():
     assert registered_rules() == (
         "KUKE001", "KUKE002", "KUKE003", "KUKE004",
-        "KUKE005", "KUKE006", "KUKE007", "KUKE008",
+        "KUKE005", "KUKE006", "KUKE007", "KUKE008", "KUKE009",
     )
+
+
+# --- structured output (--format json|github) --------------------------------
+
+
+def test_cli_format_json(tmp_path, capsys):
+    pkg = _mini_repo(tmp_path, {"runtime/thing.py": LOCKED_CLASS + '''
+        def racy(self):
+            self.depth = 5
+    '''})
+    bl = str(tmp_path / "bl.json")
+    assert kukelint_main([pkg, "--baseline", bl, "--select", "KUKE005",
+                          "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["tool"] == "kukelint"
+    (f,) = doc["findings"]
+    assert f["rule"] == "KUKE005"
+    assert f["file"].endswith("runtime/thing.py")
+    assert f["line"] > 0 and f["scope"] == "Engine.racy"
+    # The stable id IS the baseline fingerprint: line-independent.
+    assert f["id"].startswith("KUKE005:")
+    assert f["id"].endswith(":Engine.racy:depth")
+    assert doc["stale_baseline_entries"] == []
+
+    # A clean tree emits an empty findings list, exit 0.
+    pkg_ok = _mini_repo(tmp_path / "ok", {"runtime/thing.py": LOCKED_CLASS})
+    assert kukelint_main([pkg_ok, "--baseline", bl, "--select", "KUKE005",
+                          "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["findings"] == []
+
+
+def test_cli_format_github(tmp_path, capsys):
+    pkg = _mini_repo(tmp_path, {"runtime/thing.py": LOCKED_CLASS + '''
+        def racy(self):
+            self.depth = 5
+    '''})
+    assert kukelint_main([pkg, "--baseline", str(tmp_path / "bl.json"),
+                          "--select", "KUKE005",
+                          "--format", "github"]) == 1
+    out = capsys.readouterr().out
+    assert out.startswith("::error file=")
+    assert ",line=" in out and "title=KUKE005::" in out
+
+
+# --- guarded-by contract export ----------------------------------------------
+
+
+def test_write_contracts_cli_and_shape(tmp_path, capsys):
+    pkg = _mini_repo(tmp_path, {"runtime/thing.py": LOCKED_CLASS + '''
+        def annotated(self):
+            with self._lock:
+                self.extra = 1   # guarded-by: _lock
+    '''})
+    out_path = str(tmp_path / "guarded_by.json")
+    assert kukelint_main([pkg, "--write-contracts", out_path]) == 0
+    capsys.readouterr()
+    with open(out_path) as f:
+        doc = json.load(f)
+    assert doc["version"] == 1
+    # The mini repo's package dir is "pkg": dotted module keys.
+    entry = doc["classes"]["pkg.runtime.thing.Engine"]
+    assert entry["depth"] == ["_lock"]
+    assert entry["extra"] == ["_lock"]
+
+
+def test_checked_in_contract_matches_the_tree():
+    """Drift guard: analysis/guarded_by.json must equal what
+    --write-contracts would regenerate from today's sources — the runtime
+    sanitizer enforces this file, so it must never go stale."""
+    from kukeon_tpu.analysis import (
+        default_contracts_path, guarded_contracts, load_sources,
+        render_contracts,
+    )
+
+    want = render_contracts(guarded_contracts(load_sources(PKG_ROOT),
+                                              PKG_ROOT))
+    with open(default_contracts_path()) as f:
+        assert f.read() == want, (
+            "analysis/guarded_by.json is stale — regenerate with "
+            "`python -m kukeon_tpu.analysis --write-contracts`")
+
+
+def test_contract_covers_engine_and_lifecycle():
+    """The real tree's contract names the invariants kukesan enforces in
+    the sanitized tier-1 run (spot anchor, not exhaustive)."""
+    from kukeon_tpu.analysis import default_contracts_path
+
+    with open(default_contracts_path()) as f:
+        classes = json.load(f)["classes"]
+    eng = classes["kukeon_tpu.serving.engine.ServingEngine"]
+    assert eng["last_progress"] == ["_lock"]
+    assert eng["_running"] == ["_lock"]
+    mix = classes["kukeon_tpu.runtime.serving_cell.LifecycleMixin"]
+    assert mix["draining"] == ["_drain_lock"]
 
 
 def test_analyzer_package_passes_its_own_lint():
@@ -500,13 +691,15 @@ def test_cli_runs_clean_on_the_real_package():
 
 
 def test_mypy_strict_modules_typecheck():
-    """The two strictly-annotated modules (pyproject [tool.mypy] overrides:
-    obs/registry.py, serving/kv_pages.py) pass mypy. Skips cleanly where
-    mypy is not installed — the container does not bake it."""
+    """The strictly-annotated modules (pyproject [tool.mypy] overrides:
+    obs/registry.py, serving/kv_pages.py, gateway/router.py, and the
+    sanitize package) pass mypy. Skips cleanly where mypy is not
+    installed — the container does not bake it."""
     pytest.importorskip("mypy")
     proc = subprocess.run(
         [sys.executable, "-m", "mypy",
-         "kukeon_tpu/obs/registry.py", "kukeon_tpu/serving/kv_pages.py"],
+         "kukeon_tpu/obs/registry.py", "kukeon_tpu/serving/kv_pages.py",
+         "kukeon_tpu/gateway/router.py", "kukeon_tpu/sanitize"],
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=300,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
