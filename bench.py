@@ -788,18 +788,49 @@ def _dump_evidence(run_path: str, daemon_log: str, cli: list[str],
     _log(f"=== end evidence (run {run}) ===")
 
 
+def _cold_start_phases(port: int) -> dict:
+    """Phase breakdown from the freshly-booted cell's own cold-start
+    gauges (kukeon_cold_start_phase_seconds{phase=} + the total): the
+    artifact records WHERE the boot time went (imports, init, compile,
+    warmup, serve), not just the total — the ROADMAP item 4 attack
+    surface. Best-effort: an older cell without the gauges yields {}."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        from kukeon_tpu.obs import federate as fed
+
+        fams = fed.parse(text)
+        out: dict = {}
+        fam = fams.get("kukeon_cold_start_phase_seconds")
+        if fam is not None:
+            for _n, labels, value in fam.samples:
+                if labels.get("phase"):
+                    out[labels["phase"]] = round(float(value), 2)
+        total = fams.get("kukeon_cold_start_seconds")
+        if total is not None and total.samples:
+            out["total"] = round(float(total.samples[0][2]), 2)
+        return out
+    except Exception:  # noqa: BLE001 — phases are evidence, never a failure
+        return {}
+
+
 def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
-                        chips: str) -> tuple[list[float], list[str]]:
+                        chips: str
+                        ) -> tuple[list[float], list[str], list[dict]]:
     """N x [fresh daemon -> kuke apply model-cell manifest -> first
     /v1/health 200]. The daemon and model server are real subprocesses on
     the real CLI path (VERDICT item 2: 'time kuke apply of a model-cell
     manifest -> first /v1/health 200').
 
-    Never raises: returns (times, errors). A failed run dumps the
-    model-server + daemon logs to stderr before its run path is removed."""
+    Never raises: returns (times, errors, per-run phase breakdowns read
+    off each booted cell's kukeon_cold_start_* gauges). A failed run dumps
+    the model-server + daemon logs to stderr before its run path is
+    removed."""
     cli = [sys.executable, "-m", "kukeon_tpu.runtime.cli"]
     times: list[float] = []
     errors: list[str] = []
+    phases: list[dict] = []
     for run in range(runs):
         run_path = tempfile.mkdtemp(prefix="kuke-bench-")
         socket_path = f"/tmp/kuked-bench-{uuid.uuid4().hex[:8]}.sock"
@@ -859,7 +890,13 @@ def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
                 time.sleep(0.25)
             dt = time.monotonic() - t0
             times.append(dt)
-            _log(f"cold start run {run}: {dt:.1f}s")
+            ph = _cold_start_phases(port)
+            if ph:
+                phases.append(ph)
+                _log(f"cold start run {run}: {dt:.1f}s "
+                     + " ".join(f"{k}={v}s" for k, v in sorted(ph.items())))
+            else:
+                _log(f"cold start run {run}: {dt:.1f}s")
             subprocess.run(
                 cli + ["--socket", socket_path, "--run-path", run_path,
                        "delete", "cell", "llm", "--force"],
@@ -880,7 +917,7 @@ def measure_cold_starts(model: str, checkpoint: str | None, runs: int,
             shutil.rmtree(run_path, ignore_errors=True)
             if os.path.exists(socket_path):
                 os.unlink(socket_path)
-    return times, errors
+    return times, errors, phases
 
 
 # --- orchestrator -------------------------------------------------------------
@@ -1014,12 +1051,12 @@ def main() -> None:
     }
 
     try:
-        cold_runs_s, cold_errors = measure_cold_starts(
+        cold_runs_s, cold_errors, cold_phases = measure_cold_starts(
             cold_model, qdir, cold_runs,
             chips=os.environ.get("KUKEON_TPU_CHIPS", "0"),
         )
     except Exception as e:  # noqa: BLE001 — belt over measure's own no-raise
-        cold_runs_s, cold_errors = [], [f"harness: {e}"]
+        cold_runs_s, cold_errors, cold_phases = [], [f"harness: {e}"], []
     cold: dict = {
         "target_s": COLD_START_TARGET_S,
         "runs_s": [round(t, 1) for t in sorted(cold_runs_s)],
@@ -1028,6 +1065,11 @@ def main() -> None:
     if cold_runs_s:
         s = sorted(cold_runs_s)
         cold["p50_s"] = round(s[len(s) // 2], 1)
+    if cold_phases:
+        # Per-run boot-phase breakdowns (kukeon_cold_start_phase_seconds
+        # read off each booted cell): the artifact names where cold-start
+        # time goes, not just how much of it there was.
+        cold["phases_s"] = cold_phases
     if cold_errors:
         cold["error"] = "; ".join(cold_errors)[-500:]
     result["cold_start"] = cold

@@ -20,6 +20,8 @@ KUKE006  lock acquisition-order cycle (potential deadlock)
 KUKE007  fault point not declared in faults.POINTS (or stale declaration)
 KUKE008  ``kukeon_*`` metric family missing from the README reference table
 KUKE009  sub-10ms ``time.sleep`` polling loop (busy-wait in disguise)
+KUKE010  span phase/mark literal not declared in ``obs/trace.py`` PHASES
+         (or stale declaration, or a dynamic phase name)
 ======== =====================================================================
 
 Zero-dependency by design (stdlib ``ast`` only): importable and runnable

@@ -1,4 +1,5 @@
-"""KUKE007/KUKE008 — declaration registries kept honest, AST-accurately.
+"""KUKE007/KUKE008/KUKE010 — declaration registries kept honest,
+AST-accurately.
 
 These replace the two grep guards that previously lived in the test suite
 (PR 3's fault-point grep, PR 4's README metric-table regex): the AST
@@ -19,6 +20,13 @@ kukeon_tpu.analysis`` and inside tier-1 via tests/test_static_analysis.py.
   parts would hide a dynamic name, so JoinedStr pieces are ignored —
   dynamic family names are not used in this codebase and should stay
   that way).
+- **KUKE010 — trace phase registry.** Every ``<span>.event("phase")``
+  mark literal in the package must be declared in ``obs/trace.py``'s
+  ``PHASES`` tuple (the vocabulary ``kuke trace`` renders and the tail
+  sampler keys off), every declared phase must have a call site, and
+  phase names must be literals — same contract shape as KUKE007.
+  ``sanitize.event(...)`` (the named-threading.Event factory) is the one
+  same-named API and is excluded by its receiver.
 """
 
 from __future__ import annotations
@@ -131,6 +139,92 @@ def collect_metric_literals(sources: Sequence[SourceFile]) -> dict[
             if s not in out or (src.rel, node.lineno) < out[s]:
                 out[s] = (src.rel, node.lineno)
     return out
+
+
+TRACE_MODULE_SUFFIX = "obs/trace.py"
+
+
+def collect_span_event_sites(sources: Sequence[SourceFile]) -> list[
+        tuple[str, str | None, int]]:
+    """(file, phase-or-None-if-dynamic, line) for each span ``.event()``
+    mark in the package. ``sanitize.event(...)`` — the named
+    threading.Event factory — shares the attribute name and is excluded
+    by its receiver; everything else dotted ``.event(`` is a span mark
+    in this codebase (Span.event, req.trace.event, span.event)."""
+    out: list[tuple[str, str | None, int]] = []
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute) or f.attr != "event":
+                continue
+            if isinstance(f.value, ast.Name) and f.value.id == "sanitize":
+                continue
+            phase = const_str(node.args[0]) if node.args else None
+            out.append((src.rel, phase, node.lineno))
+    return out
+
+
+def declared_phases(sources: Sequence[SourceFile]) -> tuple[
+        dict[str, int], str]:
+    """(phase -> line, trace.py rel path) parsed from the
+    ``PHASES = (...)`` assignment in obs/trace.py."""
+    for src in sources:
+        if not src.rel.endswith(TRACE_MODULE_SUFFIX):
+            continue
+        for node in src.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == "PHASES"
+                       for t in node.targets):
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                phases = {}
+                for elt in node.value.elts:
+                    s = const_str(elt)
+                    if s is not None:
+                        phases[s] = elt.lineno
+                return phases, src.rel
+    return {}, ""
+
+
+@register_pass(("KUKE010",))
+def check_phase_registry(sources: Sequence[SourceFile],
+                         package_root: str) -> list[Finding]:
+    declared, trace_rel = declared_phases(sources)
+    if not trace_rel:
+        return []    # no trace module in this tree (fixture packages)
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for rel, phase, line in collect_span_event_sites(sources):
+        if phase is None:
+            findings.append(Finding(
+                "KUKE010", rel, line,
+                "span event with a non-literal phase name: the phase "
+                "registry (obs/trace.py PHASES) can only be checked "
+                "against literals — name the phase inline and carry "
+                "dynamic data as event attrs",
+                scope="", detail="<dynamic>"))
+            continue
+        seen.add(phase)
+        if phase not in declared:
+            findings.append(Finding(
+                "KUKE010", rel, line,
+                f"span phase \"{phase}\" is not declared in the "
+                f"obs/trace.py PHASES registry; undeclared phases are "
+                f"invisible to `kuke trace` consumers and the tail "
+                f"sampler's keep rules",
+                scope="", detail=phase))
+    for phase, line in declared.items():
+        if phase not in seen:
+            findings.append(Finding(
+                "KUKE010", trace_rel, line,
+                f"PHASES declares \"{phase}\" but no span "
+                f".event(\"{phase}\") call site exists — remove the "
+                f"stale declaration",
+                scope="PHASES", detail=phase))
+    return findings
 
 
 @register_pass(("KUKE008",))

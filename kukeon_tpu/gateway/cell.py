@@ -12,6 +12,9 @@ Routes:
   GET  /readyz       -> 200 while >=1 replica is ready (503 otherwise)
   GET  /v1/stats     -> gateway counters + per-replica routing snapshot
   GET  /metrics      -> Prometheus exposition (kukeon_gateway_* families)
+  GET  /v1/trace     -> gateway-side proxy spans (replica attempts, retry
+                        hops, shed outcomes); ?trace_id= / ?request_id=
+                        filters, same surface as the serving cells
   POST /v1/generate  -> proxied to a replica; ``"stream": true`` bodies are
                         passed through byte-for-byte as ndjson
   POST /v1/embed     -> proxied (no affinity; embeddings are stateless)
@@ -29,14 +32,16 @@ from __future__ import annotations
 
 import argparse
 import http.client
+import itertools
 import json
 import math
 import sys
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import urlsplit
+from urllib.parse import parse_qs, urlsplit
 
-from kukeon_tpu.obs import Registry, expo
+from kukeon_tpu.obs import Registry, Tracer, expo
+from kukeon_tpu.obs import trace as obs_trace
 from kukeon_tpu.gateway.router import Router
 
 # Retry-After the gateway itself sheds with (no replica routable). Short:
@@ -53,13 +58,23 @@ class GatewayCell:
                  registry: Registry | None = None,
                  poll_interval_s: float = 0.5,
                  poll_timeout_s: float = 1.0,
-                 request_timeout_s: float = 120.0):
+                 request_timeout_s: float = 120.0,
+                 trace_capacity: int = 512):
         self.model_name = model
         self.request_timeout_s = request_timeout_s
         self.router = Router(
             [(f"r{i}", u) for i, u in enumerate(replica_urls)],
             poll_interval_s=poll_interval_s, poll_timeout_s=poll_timeout_s)
         self.started_at = time.time()
+        # Distributed tracing: the gateway is where a request's trace is
+        # born (or joined, when the client already carries a traceparent).
+        # Its proxy span records every replica attempt + retry hop and
+        # lands in this ring behind GET /v1/trace — the gateway-side half
+        # of the federated timeline `kuke trace` reconstructs. request_id
+        # here is a gateway-local sequence (the engine-side id is minted
+        # by whichever replica wins the request).
+        self.tracer = Tracer(capacity=trace_capacity)
+        self._span_seq = itertools.count()
 
         reg = registry if registry is not None else Registry()
         self.registry = reg
@@ -100,6 +115,15 @@ class GatewayCell:
                 lambda r=rep: 1.0 if r.ready else 0.0, replica=rep.name)
             depth_g.set_function(
                 lambda r=rep: float(r.queue_depth), replica=rep.name)
+        reg.register_collector(self._trace_collect)
+
+    def _trace_collect(self):
+        ss = self.tracer.sample_stats
+        yield ("kukeon_trace_tail_sampled_total", "counter",
+               "Tail-sampler verdicts on finished trace spans (error/"
+               "preempted/retried/slow spans are always kept).",
+               [({"decision": "kept"}, float(ss["kept"])),
+                ({"decision": "dropped"}, float(ss["dropped"]))])
 
     def start(self) -> None:
         self.router.start()
@@ -107,9 +131,29 @@ class GatewayCell:
     def stop(self) -> None:
         self.router.stop()
 
+    # --- distributed tracing ----------------------------------------------
+
+    def begin_span(self, route: str,
+                   ctx: "obs_trace.TraceContext | None"):
+        """The gateway-side proxy span for one request: joins the client's
+        trace when a traceparent came in, else roots a fresh one. Every
+        replica attempt/retry is recorded on it; downstream hops hang
+        under it via the propagated header."""
+        span = self.tracer.begin(next(self._span_seq), 0, trace_ctx=ctx,
+                                 component="gateway")
+        span.attrs["route"] = route
+        return span
+
+    def finish_span(self, span, outcome: str, **attrs) -> None:
+        if span is None:
+            return
+        span.attrs.update({k: v for k, v in attrs.items() if v is not None})
+        self.tracer.finish(span, outcome)
+
     # --- proxy plumbing ----------------------------------------------------
 
-    def _open(self, rep, path: str, body: bytes):
+    def _open(self, rep, path: str, body: bytes,
+              headers: dict[str, str] | None = None):
         """One upstream POST; returns (conn, resp). Caller owns closing."""
         u = urlsplit(rep.url)
         conn = http.client.HTTPConnection(u.hostname, u.port,
@@ -117,14 +161,15 @@ class GatewayCell:
         try:
             conn.request("POST", path, body=body,
                          headers={"Content-Type": "application/json",
-                                  "Content-Length": str(len(body))})
+                                  "Content-Length": str(len(body)),
+                                  **(headers or {})})
             return conn, conn.getresponse()
         except Exception:
             conn.close()
             raise
 
     def select_and_proxy(self, path: str, body: bytes,
-                         prefix_id: str | None):
+                         prefix_id: str | None, span=None):
         """Route with bounded retry until a replica yields a non-retryable
         response. Returns one of:
 
@@ -139,6 +184,13 @@ class GatewayCell:
         last: tuple | None = None   # (replica_name, status, body, retry_after)
         repolled = False
         attempts = 0
+        # Downstream hops join the gateway's trace as children of ITS span
+        # (one header for every attempt of this request — the engine-side
+        # spans of a retried request share one parent).
+        fwd_headers = (
+            {obs_trace.TRACEPARENT_HEADER: obs_trace.format_traceparent(
+                span.trace_id, span.span_id)}
+            if span is not None else None)
         while attempts < max(1, len(self.router.replicas)):
             rep, policy = self.router.pick(prefix_id, exclude=excluded)
             if rep is None:
@@ -155,14 +207,21 @@ class GatewayCell:
                 break
             attempts += 1
             self._m_routing.inc(policy=policy)
+            if span is not None:
+                span.event("proxy_attempt", replica=rep.name, policy=policy)
             rep.begin()
             try:
-                conn, resp = self._open(rep, path, body)
+                conn, resp = self._open(rep, path, body, fwd_headers)
             except OSError as e:
                 rep.end()
                 self.router.mark_unready(rep)
                 self._m_requests.inc(replica=rep.name, outcome="connect_error")
                 self._m_retries.inc(reason="connect_error")
+                if span is not None:
+                    span.event("proxy_retry", replica=rep.name,
+                               reason="connect_error")
+                    span.attrs["retries"] = (
+                        span.attrs.get("retries", 0) + 1)
                 excluded.add(rep.name)
                 last = (rep.name, None, str(e), None)
                 continue
@@ -180,11 +239,18 @@ class GatewayCell:
                     replica=rep.name,
                     outcome="shed" if resp.status == 429 else "unready")
                 self._m_retries.inc(reason=f"status_{resp.status}")
+                if span is not None:
+                    span.event("proxy_retry", replica=rep.name,
+                               reason=f"status_{resp.status}")
+                    span.attrs["retries"] = (
+                        span.attrs.get("retries", 0) + 1)
                 excluded.add(rep.name)
                 last = (rep.name, resp.status, payload, retry_after)
                 continue
             return ("response", rep, conn, resp)
         # Every replica refused or nothing was routable.
+        if span is not None:
+            span.event("proxy_shed")
         if last is not None and last[1] in (429, 503):
             self._m_shed.inc()
             return ("shed", last[1], last[2], last[3])
@@ -255,6 +321,30 @@ def make_gateway_handler(gw: GatewayCell):
             elif path == "/metrics":
                 self._send_raw(200, expo.render(gw.registry).encode(),
                                expo.CONTENT_TYPE)
+            elif path == "/v1/trace":
+                # Gateway-side proxy spans (attempts, retry hops, shed
+                # outcomes) — the front-door half of the federated trace
+                # timeline; same query surface as the serving cells.
+                q = parse_qs(urlsplit(self.path).query)
+                if "trace_id" in q:
+                    self._send(200, {"spans":
+                                     gw.tracer.for_trace(q["trace_id"][0])})
+                    return
+                if "request_id" in q:
+                    try:
+                        rid = int(q["request_id"][0])
+                    except ValueError:
+                        self._send(400, {"error":
+                                         "request_id must be an integer"})
+                        return
+                    self._send(200, {"spans": gw.tracer.for_request(rid)})
+                    return
+                try:
+                    n = int(q.get("n", ["50"])[0])
+                except ValueError:
+                    self._send(400, {"error": "n must be an integer"})
+                    return
+                self._send(200, {"spans": gw.tracer.recent(n)})
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
@@ -283,17 +373,23 @@ def make_gateway_handler(gw: GatewayCell):
                     return
                 stream = bool(req.get("stream"))
 
-            got = gw.select_and_proxy(path, body, prefix_id)
+            # The proxy span: joins the client's trace when a traceparent
+            # header came in, else roots a fresh one; every replica
+            # attempt lands on it and the downstream hop inherits it.
+            span = gw.begin_span(path, obs_trace.parse_traceparent(
+                self.headers.get(obs_trace.TRACEPARENT_HEADER)))
+            got = gw.select_and_proxy(path, body, prefix_id, span=span)
             if got[0] == "shed":
                 _tag, status, payload, retry_after = got
                 secs = float(retry_after or GATEWAY_RETRY_AFTER_S)
                 self._send_raw(status, payload or b"{}", "application/json",
                                {"Retry-After": str(max(1, math.ceil(secs)))})
+                gw.finish_span(span, "shed", status=status)
                 return
             _tag, rep, conn, resp = got
             try:
                 if stream and resp.status == 200:
-                    self._relay_stream(rep, resp)
+                    self._relay_stream(rep, resp, span)
                 else:
                     payload = resp.read()
                     headers = {}
@@ -308,13 +404,20 @@ def make_gateway_handler(gw: GatewayCell):
                         replica=rep.name,
                         outcome="ok" if resp.status < 400 else
                         f"status_{resp.status}")
+                    gw.finish_span(
+                        span, "ok" if resp.status < 400 else "error",
+                        replica=rep.name, status=resp.status)
             except OSError:
-                pass   # client went away; nothing to tell it
+                # Client went away; nothing to tell it, but the span still
+                # records the outcome (first finish wins — a stream error
+                # already finished it in-band).
+                gw.finish_span(span, "error", replica=rep.name,
+                               detail="client disconnected")
             finally:
                 conn.close()
                 rep.end()
 
-        def _relay_stream(self, rep, resp):
+        def _relay_stream(self, rep, resp, span=None):
             """Byte-for-byte ndjson passthrough. The replica frames the
             stream by connection close (its handler speaks HTTP/1.0), so
             copying raw body chunks until EOF reproduces the payload
@@ -341,8 +444,11 @@ def make_gateway_handler(gw: GatewayCell):
                     self.wfile.write(chunk)
                     self.wfile.flush()
                 gw._m_requests.inc(replica=rep.name, outcome="ok")
+                gw.finish_span(span, "ok", replica=rep.name, stream=True)
             except Exception as e:  # noqa: BLE001 — headers are out; stay in-band
                 gw._m_requests.inc(replica=rep.name, outcome="stream_error")
+                gw.finish_span(span, "error", replica=rep.name, stream=True,
+                               detail=f"{type(e).__name__}: {e}")
                 gw.router.mark_unready(rep)
                 try:
                     line = json.dumps({"error": "replica failed mid-stream: "

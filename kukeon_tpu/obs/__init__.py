@@ -24,7 +24,17 @@ from kukeon_tpu.obs.registry import (  # noqa: F401
     percentile_from_counts,
 )
 from kukeon_tpu.obs.expo import faults_collector, render  # noqa: F401
-from kukeon_tpu.obs.trace import Span, Tracer  # noqa: F401
+from kukeon_tpu.obs.trace import (  # noqa: F401
+    PHASES,
+    TRACEPARENT_HEADER,
+    Span,
+    TraceContext,
+    Tracer,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
 from kukeon_tpu.obs.device import (  # noqa: F401
     CompileTracker,
     ProfileBusy,

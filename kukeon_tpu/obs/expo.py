@@ -89,6 +89,7 @@ def render(registry) -> str:
 def _render_histogram(out: list[str], h) -> None:
     with h._lock:
         series = {k: (list(c), s, n) for k, (c, s, n) in h._series.items()}
+        exemplars = {k: dict(v) for k, v in h._exemplars.items()}
     if not series:
         # An empty histogram still exposes a zero-count labelless series
         # only when it has no label dimensions (a scraper then sees the
@@ -109,6 +110,19 @@ def _render_histogram(out: list[str], h) -> None:
         out.append(f"{h.name}_bucket{_labels_str(le)} {n}")
         out.append(f"{h.name}_sum{_labels_str(labels)} {_fmt(total)}")
         out.append(f"{h.name}_count{_labels_str(labels)} {n}")
+        # Exemplar comment lines: one per bucket that has a trace id
+        # attached. Comments, so any 0.0.4 scraper ignores them; the
+        # in-repo federation parser extracts them (so `kuke top`'s p95
+        # row can name a reconstructable trace) and the golden-format
+        # test pins the syntax.
+        for idx in sorted(exemplars.get(key, {})):
+            v, ex = exemplars[key][idx]
+            exl = dict(labels)
+            exl["le"] = (_fmt_le(h.buckets[idx])
+                         if idx < len(h.buckets) else "+Inf")
+            out.append(
+                f"# EXEMPLAR {h.name}_bucket{_labels_str(exl)} "
+                f'trace_id="{ex}" value={_fmt(v)}')
 
 
 def faults_collector():

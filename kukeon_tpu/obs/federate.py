@@ -26,6 +26,14 @@ _SAMPLE_RE = re.compile(
 )
 _LABEL_RE = re.compile(r'([a-zA-Z0-9_]+)="((?:[^"\\]|\\.)*)"')
 _SUFFIX_RE = re.compile(r"_(bucket|sum|count)$")
+# Histogram exemplar comment lines (expo._render_histogram): carried
+# through federation so the daemon's merged exposition (and `kuke top`)
+# can link a latency bucket to a reconstructable trace id.
+_EXEMPLAR_RE = re.compile(
+    r'^# EXEMPLAR ([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{.*\})?'
+    r' trace_id="([0-9a-fA-F]*)" value=(\S+)$'
+)
 
 
 @dataclasses.dataclass
@@ -35,6 +43,9 @@ class Family:
     help: str = ""
     # (sample name incl. _bucket/_sum/_count suffix, labels, value string)
     samples: list[tuple[str, dict[str, str], str]] = dataclasses.field(
+        default_factory=list)
+    # (sample name, labels incl. le, trace id, value string)
+    exemplars: list[tuple[str, dict[str, str], str, str]] = dataclasses.field(
         default_factory=list)
 
 
@@ -55,6 +66,20 @@ def parse(text: str) -> dict[str, Family]:
             if kind not in ("counter", "gauge", "histogram", "untyped"):
                 raise ValueError(f"unknown metric type in {line!r}")
             families.setdefault(name, Family(name)).kind = kind
+        elif line.startswith("# EXEMPLAR "):
+            m = _EXEMPLAR_RE.match(line)
+            if not m:
+                raise ValueError(f"malformed exemplar line {line!r}")
+            sample_name = m.group(1)
+            fam = families.get(sample_name) or families.get(
+                _SUFFIX_RE.sub("", sample_name))
+            if fam is None:
+                raise ValueError(
+                    f"exemplar before family declaration: {line!r}")
+            labels = ({k: v for k, v in _LABEL_RE.findall(m.group(2))}
+                      if m.group(2) else {})
+            fam.exemplars.append((sample_name, labels, m.group(3),
+                                  m.group(4)))
         elif line.startswith("#"):
             continue
         else:
@@ -81,6 +106,11 @@ def inject_label(families: dict[str, Family], **labels: str) -> None:
             (name, {**lab, **{k: str(v) for k, v in labels.items()}}, value)
             for name, lab, value in fam.samples
         ]
+        fam.exemplars = [
+            (name, {**lab, **{k: str(v) for k, v in labels.items()}},
+             trace_id, value)
+            for name, lab, trace_id, value in fam.exemplars
+        ]
 
 
 def render(families: dict[str, Family]) -> str:
@@ -92,6 +122,9 @@ def render(families: dict[str, Family]) -> str:
         out.append(f"# TYPE {fam.name} {fam.kind}")
         for name, labels, value in fam.samples:
             out.append(f"{name}{expo._labels_str(labels)} {value}")
+        for name, labels, trace_id, value in fam.exemplars:
+            out.append(f"# EXEMPLAR {name}{expo._labels_str(labels)} "
+                       f'trace_id="{trace_id}" value={value}')
     return "\n".join(out) + "\n"
 
 
@@ -104,9 +137,11 @@ def merge(parts: list[dict[str, Family]]) -> dict[str, Family]:
             tgt = merged.get(name)
             if tgt is None:
                 merged[name] = Family(name, fam.kind, fam.help,
-                                      list(fam.samples))
+                                      list(fam.samples),
+                                      list(fam.exemplars))
             else:
                 tgt.samples.extend(fam.samples)
+                tgt.exemplars.extend(fam.exemplars)
     return merged
 
 

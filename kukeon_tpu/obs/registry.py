@@ -171,8 +171,14 @@ class Histogram(_Metric):
         self.buckets = b
         # per labelset: ([count per finite bucket] + [overflow], sum, count)
         self._series: dict[_LabelKey, tuple[list[int], float, int]] = {}
+        # per labelset: {bucket index: (value, exemplar id)} — the last
+        # observation per bucket that carried an exemplar. Exemplars link
+        # a histogram bucket to a reconstructable trace: the TTFT p95 row
+        # in `kuke top` resolves to a real `kuke trace <id>` timeline.
+        self._exemplars: dict[_LabelKey, dict[int, tuple[float, str]]] = {}
 
-    def observe(self, value: float, **labels: object) -> None:
+    def observe(self, value: float, exemplar: str | None = None,
+                **labels: object) -> None:
         key = _label_key(self.label_names, labels)
         v = float(value)
         with self._lock:
@@ -180,11 +186,22 @@ class Histogram(_Metric):
                 key, ([0] * (len(self.buckets) + 1), 0.0, 0))
             for i, b in enumerate(self.buckets):
                 if v <= b:
+                    idx = i
                     counts[i] += 1
                     break
             else:
+                idx = len(self.buckets)
                 counts[-1] += 1
             self._series[key] = (counts, total + v, n + 1)
+            if exemplar is not None:
+                self._exemplars.setdefault(key, {})[idx] = (v, str(exemplar))
+
+    def exemplars(self, **labels: object) -> dict[int, tuple[float, str]]:
+        """{bucket index: (value, exemplar id)} for one labelset; the
+        index ``len(buckets)`` is the overflow (+Inf) slot."""
+        key = _label_key(self.label_names, labels)
+        with self._lock:
+            return dict(self._exemplars.get(key, {}))
 
     def snapshot(self, **labels: object) -> tuple[list[int], float, int]:
         """(per-bucket counts + overflow, sum, count) for one labelset."""

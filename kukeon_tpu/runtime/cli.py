@@ -3,8 +3,8 @@
 Verbs: init, daemon (serve/start/stop/kill/restart/status/logs/metrics),
 apply,
 create, delete, get, run, start, stop, kill, attach, log, purge, refresh,
-rollout, status, top, doctor, image, build, team, uninstall, version,
-autocomplete.
+rollout, status, top, trace, doctor, image, build, team, uninstall,
+version, autocomplete.
 
 Workload verbs route to the daemon; read/maintenance verbs "promote" to an
 in-process controller when --no-daemon / KUKEON_NO_DAEMON is set (reference
@@ -694,13 +694,99 @@ def cmd_top(args):
         if r.get("hbmInUseBytes") is not None:
             hbm = (f"{_fmt_bytes(r['hbmInUseBytes'])}"
                    f"/{_fmt_bytes(r.get('hbmLimitBytes'))}")
+        # The TTFT histogram's top-bucket exemplar: the p95 row links
+        # directly to a reconstructable trace (`kuke trace <id>`).
+        exemplar = (f"  (p95 trace={r['ttftP95TraceId']})"
+                    if r.get("ttftP95TraceId") else "")
         print(fmt.format(
             r["cell"], r.get("model") or "-",
             "yes" if r.get("ready") else "no",
             f"{r['qps']:.1f}" if r.get("qps") is not None else "-",
             _fmt_ms(r.get("ttftP50S")), _fmt_ms(r.get("ttftP95S")),
-            r.get("queueDepth", "-"), hbm, r.get("restarts", 0)))
+            r.get("queueDepth", "-"), hbm, r.get("restarts", 0))
+            + exemplar)
     return 0
+
+
+def _span_detail(span: dict) -> str:
+    """One span's human detail column: replica attempts and retry hops for
+    gateway spans, token counts for engine spans, error text for failures."""
+    bits: list[str] = []
+    hops = [e for e in span.get("events", [])
+            if e.get("event") in ("proxy_attempt", "proxy_retry")]
+    if hops:
+        parts = []
+        for e in hops:
+            a = e.get("attrs") or {}
+            if e["event"] == "proxy_attempt":
+                parts.append(a.get("replica", "?"))
+            else:
+                parts[-1:] = [f"{parts[-1] if parts else '?'}"
+                              f"!{a.get('reason', 'retry')}"]
+        bits.append("attempts " + " -> ".join(parts))
+    if span.get("tokens"):
+        bits.append(f"{span['tokens']} tokens")
+    if span.get("attrs", {}).get("retries"):
+        bits.append(f"retries={span['attrs']['retries']}")
+    if span.get("error"):
+        bits.append(span["error"])
+    return "; ".join(bits)
+
+
+def render_trace(trace_id: str, spans: list[dict]) -> str:
+    """The reconstructed cross-component timeline for one trace: every
+    span (gateway proxy, each replica attempt's engine span, boot spans)
+    on one time axis, children indented under their parent span, with
+    stage, cell, phase durations, retry hops, and outcome. Pure so tests
+    drive it without a daemon."""
+    if not spans:
+        return f"trace {trace_id}: no spans found"
+    base = min(s.get("startedAt") or 0.0 for s in spans)
+    by_id = {s.get("spanId"): s for s in spans}
+
+    def depth(s: dict) -> int:
+        d, seen = 0, set()
+        while s.get("parentSpanId") in by_id and s["spanId"] not in seen:
+            seen.add(s["spanId"])
+            s = by_id[s["parentSpanId"]]
+            d += 1
+        return d
+
+    lines = [f"trace {trace_id} — {len(spans)} span(s)"]
+    for s in sorted(spans, key=lambda x: (x.get("startedAt") or 0.0)):
+        indent = "  " * (1 + depth(s))
+        offset = (s.get("startedAt") or base) - base
+        phases = " | ".join(
+            f"{k} {v * 1000:.1f}ms" for k, v in (s.get("phasesS") or
+                                                 {}).items() if v)
+        detail = _span_detail(s)
+        lines.append(
+            f"{indent}+{offset:7.3f}s {s.get('component', '?'):<8}"
+            f" {s.get('cell', '-'):<28}"
+            f" {s.get('outcome') or '?':<9}"
+            f" e2e {(s.get('e2eS') or 0) * 1000:8.1f}ms"
+            + (f"  [{phases}]" if phases else "")
+            + (f"  {detail}" if detail else ""))
+    return "\n".join(lines)
+
+
+def cmd_trace(args):
+    """Render one distributed trace end to end: the daemon unions every
+    model cell's /v1/trace ring (gateway + all replicas) for this trace id
+    and this prints the reconstructed timeline — which replica(s) a
+    request hit, every retry hop, and how the engine phases partition the
+    request's wall time."""
+    try:
+        out = _client(args).call("Traces", traceId=args.trace_id)
+    except KukeonError as e:
+        print(f"daemon unreachable: {e}", file=sys.stderr)
+        return 1
+    spans = out.get("spans", [])
+    if args.json:
+        _print(spans, True)
+        return 0
+    print(render_trace(args.trace_id, spans))
+    return 0 if spans else 1
 
 
 def cmd_rollout(args):
@@ -825,7 +911,7 @@ _BASH_COMPLETION = """\
 _kuke_complete() {
     local cur="${COMP_WORDS[COMP_CWORD]}" prev="${COMP_WORDS[COMP_CWORD-1]}"
     local verbs="init apply create build daemon get delete doctor start status \
-stop team kill purge refresh rollout run attach log top autocomplete image uninstall version"
+stop team kill purge refresh rollout run attach log top trace autocomplete image uninstall version"
     if [ "$COMP_CWORD" -eq 1 ]; then
         COMPREPLY=($(compgen -W "$verbs" -- "$cur")); return
     fi
@@ -990,6 +1076,11 @@ def build_parser() -> argparse.ArgumentParser:
     sub_add("doctor")
     sub_add("refresh")
 
+    sp = sub_add("trace")
+    sp.add_argument("trace_id",
+                    help="32-hex trace id (from logs, /v1/trace, or the "
+                         "TTFT exemplar in `kuke top`)")
+
     sp = sub_add("rollout")
     sp.add_argument("name")
     sp.add_argument("--drain-timeout", type=float, default=60.0,
@@ -1064,6 +1155,7 @@ HANDLERS = {
     "log": cmd_log,
     "status": cmd_status,
     "top": cmd_top,
+    "trace": cmd_trace,
     "rollout": cmd_rollout,
     "doctor": cmd_doctor,
     "refresh": cmd_refresh,

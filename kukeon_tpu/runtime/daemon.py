@@ -125,6 +125,53 @@ def scrape_fleet(ctl, timeout_s: float | None = None) -> list[dict]:
     ]
 
 
+def fetch_traces(endpoints: list[tuple[str, str, dict]],
+                 trace_id: str | None = None, n: int = 50,
+                 timeout_s: float | None = None) -> list[dict]:
+    """Union every cell's ``/v1/trace`` ring (gateway included — it is
+    the base endpoint of a replicated cell) into one span list, each span
+    tagged with its cell key. Concurrent, per-cell timeout, never raises:
+    a cell without a tracer (embedding flavor answers 404) or an
+    unreachable one simply contributes nothing — federated trace
+    reconstruction must degrade span-by-span, not fail wholesale.
+
+    Spans come back sorted by wall-clock start so a renderer can lay the
+    cross-component timeline without re-sorting."""
+    import urllib.request
+    from urllib.parse import quote
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get(SCRAPE_TIMEOUT_ENV, "") or
+                          DEFAULT_SCRAPE_TIMEOUT_S)
+    query = (f"?trace_id={quote(trace_id)}" if trace_id
+             else f"?n={int(n)}")
+    results: list[list[dict]] = [[] for _ in endpoints]
+
+    def work(i: int, key: str, url: str) -> None:
+        try:
+            with urllib.request.urlopen(url + "/v1/trace" + query,
+                                        timeout=timeout_s) as r:
+                spans = json.loads(r.read()).get("spans", [])
+        except Exception:  # noqa: BLE001 — a dead/traceless cell contributes nothing
+            return
+        for s in spans:
+            if isinstance(s, dict):
+                s["cell"] = key
+                results[i].append(s)
+
+    threads = [threading.Thread(target=work, args=(i, key, url),
+                                daemon=True, name=f"trace-{key}")
+               for i, (key, url, _rec) in enumerate(endpoints)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + timeout_s * 2 + 1.0
+    for t in threads:
+        t.join(timeout=max(0.1, deadline - time.monotonic()))
+    out = [s for part in results for s in part]
+    out.sort(key=lambda s: s.get("startedAt") or 0.0)
+    return out
+
+
 def _sample_value(fams: dict, name: str, **match) -> float | None:
     fam = fams.get(name)
     if fam is None:
@@ -168,6 +215,17 @@ def summarize_cell_scrape(fams: dict) -> dict:
                 percentile_from_counts(bounds, counts, 0.5), 5)
             out["ttftP95S"] = round(
                 percentile_from_counts(bounds, counts, 0.95), 5)
+        # Exemplar: the trace id attached to the highest populated TTFT
+        # bucket — `kuke top`'s p95 row links straight to a trace that
+        # `kuke trace <id>` can reconstruct.
+        def _le(labels: dict) -> float:
+            le = labels.get("le", "")
+            return float("inf") if le == "+Inf" else float(le or 0)
+        if ttft.exemplars:
+            _n, _lab, tid, _v = max(ttft.exemplars,
+                                    key=lambda e: _le(e[1]))
+            if tid:
+                out["ttftP95TraceId"] = tid
     for key, name in (("hbmInUseBytes", "kukeon_hbm_bytes_in_use"),
                       ("hbmLimitBytes", "kukeon_hbm_bytes_limit")):
         v = _sample_sum(fams, name)
@@ -571,6 +629,17 @@ class RPCService:
                 row["error"] = s["error"]
             rows.append(row)
         return {"cells": rows}
+
+    def Traces(self, traceId: str | None = None, n: int = 50,
+               timeoutS: float | None = None) -> dict:
+        """Federated trace reconstruction, mirroring the Metrics RPC's
+        federation: union every running model cell's ``/v1/trace`` ring
+        (gateway base endpoint + each replica) — filtered to one trace id
+        when given — each span tagged with its cell key. `kuke trace
+        <trace-id>` renders the result as a cross-component timeline."""
+        spans = fetch_traces(model_cell_endpoints(self.ctl),
+                             trace_id=traceId, n=n, timeout_s=timeoutS)
+        return {"spans": spans}
 
     def RolloutCell(self, realm: str, space: str, stack: str, name: str,
                     drainTimeoutS: float = 60.0,

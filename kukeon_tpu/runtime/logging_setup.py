@@ -10,10 +10,11 @@ cell), and level resolution from KUKEOND_LOG_LEVEL / ServerConfiguration.
 ``KUKEON_LOG_FORMAT=json`` (or ``setup(fmt="json")``) switches every line
 to one JSON object: ``{"ts", "level", "msg", "logger"}`` plus whatever
 correlation fields the call site attached via ``extra=`` — the serving
-engine stamps ``request_id`` and ``phase`` on request-lifecycle records,
-and the ambient cell name (KUKEON_CELL, injected by the runner) rides
-along as ``cell`` — so a log pipeline joins log lines to /v1/trace spans
-by request id. Plain text remains the default.
+engine stamps ``request_id``, ``trace_id``, and ``phase`` on
+request-lifecycle records, and the ambient cell name (KUKEON_CELL,
+injected by the runner) rides along as ``cell`` — so a log pipeline joins
+log lines to /v1/trace spans (and to the cross-component trace ``kuke
+trace`` reconstructs) on one key. Plain text remains the default.
 """
 
 from __future__ import annotations
@@ -25,7 +26,11 @@ import sys
 import time
 
 # Correlation fields lifted from record ``extra=`` into the JSON object.
-_EXTRA_FIELDS = ("request_id", "cell", "phase", "point", "outcome")
+# ``trace_id`` is the distributed-trace join key: a JSON log line and the
+# /v1/trace span it belongs to share it, so logs and traces join on one
+# key across gateway, replicas, and engines.
+_EXTRA_FIELDS = ("request_id", "trace_id", "cell", "phase", "point",
+                 "outcome")
 
 _LEVELS = {
     "debug": logging.DEBUG,

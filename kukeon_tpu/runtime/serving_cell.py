@@ -59,10 +59,16 @@ from kukeon_tpu.obs import (
     device_memory_collector,
     expo,
 )
+from kukeon_tpu.obs import trace as obs_trace
 from kukeon_tpu.serving.engine import DeadlineExceeded, RejectedError
 
 MODELS = {}
 EMBEDDING_MODELS = {}
+
+# Process birth (well, module import — the runner execs `python -m
+# kukeon_tpu.runtime.serving_cell`, so they coincide in production):
+# the zero point for the cold-start phase breakdown finish_boot exports.
+_PROC_T0 = time.monotonic()
 
 # Exit code for a watchdog-confirmed wedged TPU runtime: nonzero so the
 # runner's restart policy (always / on-failure) restarts the cell, distinct
@@ -306,6 +312,11 @@ class ServingCell(LifecycleMixin):
                  deadline_s: float | None = None,
                  slo_ttft_p95_ms: float | None = None,
                  slo_availability: float | None = None):
+        # Cold-start phase marks (monotonic). "boot_imports" is everything
+        # between process start and constructor entry — interpreter boot,
+        # module imports, argparse; the remaining phases are stamped as
+        # the boot pipeline advances and exported by finish_boot().
+        self._boot_marks: dict[str, float] = {"init_entry": time.monotonic()}
         import jax
 
         _enable_compilation_cache()
@@ -424,6 +435,7 @@ class ServingCell(LifecycleMixin):
             ttft_p95_ms=(slo_ttft_p95_ms if slo_ttft_p95_ms
                          else d.ttft_p95_ms),
         ))
+        self._boot_marks["init_exit"] = time.monotonic()
 
     @staticmethod
     def _load_checkpoint(path: str, cfg, quantize: bool = False):
@@ -462,9 +474,58 @@ class ServingCell(LifecycleMixin):
 
     def warmup(self, prompt_len: int = 64):
         # Compile first (needs shapes only — overlaps the async weight
-        # transfer), then run the real warmup pass (needs the weights).
+        # transfer), then run the real warmup pass (needs the weights; the
+        # "warmup" phase therefore also absorbs whatever remains of the
+        # async checkpoint transfer).
         self.engine.precompile((prompt_len,))
+        self._boot_marks.setdefault("compile_done", time.monotonic())
         self.engine.warmup(prompt_len)
+        self._boot_marks.setdefault("warmup_done", time.monotonic())
+
+    def finish_boot(self) -> dict[str, float]:
+        """Close out the cold-start trace: compute the boot phase
+        breakdown, export ``kukeon_cold_start_seconds`` (total) +
+        ``kukeon_cold_start_phase_seconds{phase=}``, and drop a
+        ``component="boot"`` span into the trace ring so ``kuke trace``
+        can render the boot timeline like any request. Called once from
+        main() right before the cell goes ready; bench.py's cold-start
+        phase reads these gauges off the first /metrics scrape."""
+        now = time.monotonic()
+        m = self._boot_marks
+        phases: dict[str, float] = {
+            "imports": m["init_entry"] - _PROC_T0,
+            "init": m.get("init_exit", m["init_entry"]) - m["init_entry"],
+        }
+        if "compile_done" in m:
+            phases["compile"] = m["compile_done"] - m.get("init_exit",
+                                                          m["init_entry"])
+            phases["warmup"] = m.get("warmup_done",
+                                     m["compile_done"]) - m["compile_done"]
+        total = now - _PROC_T0
+        phases["serve"] = max(0.0, total - sum(phases.values()))
+        reg = self.registry
+        reg.gauge(
+            "kukeon_cold_start_seconds",
+            "Process start -> ready wall time (the rolling-restart and "
+            "autoscaling latency floor).").set(total)
+        g = reg.gauge("kukeon_cold_start_phase_seconds",
+                      "Cold-start breakdown by boot phase.",
+                      labels=("phase",))
+        for phase, dt in phases.items():
+            g.set(dt, phase=phase)
+        # Each event marks where its phase BEGINS, so the span's phase
+        # durations (gap to the next event) mirror the gauge breakdown;
+        # the tail gap (warmup start -> finished) covers warmup + serve.
+        span = self.engine.tracer.begin(-2, 0, component="boot",
+                                        start_mono=_PROC_T0)
+        span.event("boot_imports", at=_PROC_T0)
+        span.event("boot_init", at=m["init_entry"])
+        if "compile_done" in m:
+            span.event("boot_compile", at=m.get("init_exit",
+                                                m["init_entry"]))
+            span.event("boot_warmup", at=m["compile_done"])
+        self.engine.tracer.finish(span, "ok")
+        return phases
 
     def _parse_generate(self, req: dict):
         from kukeon_tpu.serving import SamplingParams
@@ -497,11 +558,12 @@ class ServingCell(LifecycleMixin):
                 raise ValueError("deadlineS must be positive")
         return prompt, sp, list(stops), prefix_id, deadline_s
 
-    def generate(self, req: dict) -> dict:
+    def generate(self, req: dict,
+                 trace_ctx: "obs_trace.TraceContext | None" = None) -> dict:
         """Non-streaming generation: the terminal record of the streaming
         path (one machinery for both modes — stop handling included)."""
         out = None
-        for out in self.generate_stream(req):
+        for out in self.generate_stream(req, trace_ctx=trace_ctx):
             pass
         if out.get("timedOut"):
             raise DeadlineExceeded(out["error"])
@@ -509,7 +571,8 @@ class ServingCell(LifecycleMixin):
             raise RuntimeError(out["error"])
         return {k: out[k] for k in ("tokens", "text", "numTokens", "seconds")}
 
-    def generate_stream(self, req: dict):
+    def generate_stream(self, req: dict,
+                        trace_ctx: "obs_trace.TraceContext | None" = None):
         """Streaming generation: yields one JSON-line dict per token as the
         engine emits them (an agent session reads tokens as they decode
         instead of waiting for the full completion), then a terminal record
@@ -526,7 +589,8 @@ class ServingCell(LifecycleMixin):
         t0 = time.monotonic()
         r = self.engine.submit(prompt, sp,
                                emit=lambda tok, done: events.put((tok, done)),
-                               prefix_id=prefix_id, deadline_s=deadline_s)
+                               prefix_id=prefix_id, deadline_s=deadline_s,
+                               trace_ctx=trace_ctx)
         driving = not self.engine._running   # direct use without the thread
         tokens: list[int] = []
         emitted = ""
@@ -923,6 +987,13 @@ def make_handler(cell: ServingCell):
                                               "request traces"})
                     return
                 q = parse_qs(parts.query)
+                if "trace_id" in q:
+                    # Distributed-trace pull: the daemon's Traces RPC (and
+                    # `kuke trace <id>`) fan this out to every cell and
+                    # union the spans into one timeline.
+                    self._send(200, {"spans":
+                                     tracer.for_trace(q["trace_id"][0])})
+                    return
                 if "request_id" in q:
                     # Exact-match pull: a slow request found in the logs is
                     # fetched directly instead of paging the ?n=K tail.
@@ -993,6 +1064,12 @@ def make_handler(cell: ServingCell):
                 faults.maybe_fail("cell.http")
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
+                # Distributed trace context: the gateway (or any client)
+                # propagates a traceparent header; the engine's span joins
+                # that trace instead of rooting a fresh one. Malformed
+                # headers degrade to a fresh root trace.
+                ctx = obs_trace.parse_traceparent(
+                    self.headers.get(obs_trace.TRACEPARENT_HEADER))
                 # Lifecycle admission first (503), then the engine's own
                 # queue-full shedding fires inside submit (429).
                 if hasattr(cell, "check_admission"):
@@ -1002,7 +1079,10 @@ def make_handler(cell: ServingCell):
                     tracked = True
                 if (self.path == "/v1/generate" and req.get("stream")
                         and hasattr(cell, "generate_stream")):
-                    self._stream(cell.generate_stream(req))
+                    self._stream(cell.generate_stream(req, trace_ctx=ctx))
+                    return
+                if self.path == "/v1/generate" and hasattr(cell, "generate"):
+                    self._send(200, cell.generate(req, trace_ctx=ctx))
                     return
                 self._send(200, fn(req))
             except RejectedError as e:
@@ -1130,6 +1210,11 @@ def main(argv=None) -> int:
     server = ThreadingHTTPServer((args.host, args.port), make_handler(cell))
     # /readyz goes true only now: weights loaded, warmup done, server bound.
     cell.on_drained = server.shutdown
+    if isinstance(cell, ServingCell):
+        # Close out the cold-start trace: kukeon_cold_start_seconds (+ the
+        # per-phase breakdown) lands on /metrics and the boot span joins
+        # the trace ring — bench.py's cold-start phase reads both.
+        cell.finish_boot()
     cell.mark_ready()
 
     # SIGTERM = drain (the runner's stop path sends it with a grace window):

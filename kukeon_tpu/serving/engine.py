@@ -1007,6 +1007,12 @@ class ServingEngine:
                "Prefix-KV cache lookups by result.",
                [({"result": "hit"}, float(self.prefix_hits)),
                 ({"result": "miss"}, float(self.prefix_misses))])
+        ss = self.tracer.sample_stats
+        yield ("kukeon_trace_tail_sampled_total", "counter",
+               "Tail-sampler verdicts on finished trace spans (error/"
+               "preempted/retried/slow spans are always kept).",
+               [({"decision": "kept"}, float(ss["kept"])),
+                ({"decision": "dropped"}, float(ss["dropped"]))])
 
     def _observe_terminal(self, req: Request, outcome: str) -> None:
         """Record a request's terminal event on every instrument at once:
@@ -1015,7 +1021,10 @@ class ServingEngine:
         thread (or hold the failure path), and Tracer.finish is idempotent
         so a double-fault keeps the first verdict."""
         if req.submitted_at:
-            self._m_e2e.observe(time.monotonic() - req.submitted_at)
+            self._m_e2e.observe(
+                time.monotonic() - req.submitted_at,
+                exemplar=(req.trace.trace_id
+                          if req.trace is not None else None))
         self._m_requests.inc(outcome=outcome)
         if req.trace is not None:
             self.tracer.finish(
@@ -1025,7 +1034,9 @@ class ServingEngine:
             )
         _LOG.debug("request %d %s (%d tokens)", req.id, outcome,
                    len(req.generated),
-                   extra={"request_id": req.id, "phase": outcome})
+                   extra={"request_id": req.id, "phase": outcome,
+                          "trace_id": (req.trace.trace_id
+                                       if req.trace is not None else None)})
 
     def _ensure_loaded(self):
         """Block until the (possibly async) weight transfer finished."""
@@ -1142,6 +1153,7 @@ class ServingEngine:
         emit: Callable[[int, bool], None] | None = None,
         prefix_id: str | None = None,
         deadline_s: float | None = None,
+        trace_ctx: "Any | None" = None,
     ) -> Request:
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
@@ -1184,16 +1196,21 @@ class ServingEngine:
         if shed_depth is not None:
             # Shed accounting outside the lock: counter + a zero-length
             # trace span (id -1: the request never earned one) so the shed
-            # path is visible in /v1/trace, not just as a counter.
+            # path is visible in /v1/trace, not just as a counter. The
+            # span joins the caller's trace when a context came with the
+            # request — a gateway retry's shed hop is part of ONE trace.
             self._m_shed.inc(reason="rejected")
             self._m_requests.inc(outcome="shed")
-            self.tracer.finish(self.tracer.begin(-1, prompt.size), "shed")
+            self.tracer.finish(
+                self.tracer.begin(-1, prompt.size, trace_ctx=trace_ctx),
+                "shed")
             raise RejectedError(
                 f"pending queue full ({shed_depth}/"
                 f"{self.max_pending}); shedding load",
                 retry_after_s=self.retry_after_s,
             )
-        req.trace = self.tracer.begin(req.id, int(prompt.size))
+        req.trace = self.tracer.begin(req.id, int(prompt.size),
+                                      trace_ctx=trace_ctx)
         self._pending.put(req)
         with self._lock:
             # Wake an idle engine loop parked on the work condition.
@@ -1986,7 +2003,9 @@ class ServingEngine:
         self._resume.append(req)
         _LOG.debug("request %d preempted (%s), %d tokens so far",
                    req.id, reason, len(req.generated),
-                   extra={"request_id": req.id, "phase": "preempted"})
+                   extra={"request_id": req.id, "phase": "preempted",
+                          "trace_id": (req.trace.trace_id
+                                       if req.trace is not None else None)})
 
     def _ensure_decode_pages(self, k: int) -> None:
         """Grow every active slot's block table to cover the next ``k``
@@ -2095,7 +2114,10 @@ class ServingEngine:
         now = time.monotonic()
         if not req.generated:
             req.first_token_at = now
-            self._m_ttft.observe(now - req.submitted_at)
+            self._m_ttft.observe(
+                now - req.submitted_at,
+                exemplar=(req.trace.trace_id
+                          if req.trace is not None else None))
             if req.trace is not None:
                 req.trace.event("first_token")
         elif req.last_token_at:

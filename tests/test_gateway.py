@@ -504,7 +504,7 @@ def test_stream_through_gateway_from_real_cell_holds_back_split_utf8():
         shed_stats = {"rejected": 0, "timed_out": 0, "kv_exhausted": 0}
 
         def submit(self, prompt, sp, emit=None, prefix_id=None,
-                   deadline_s=None):
+                   deadline_s=None, trace_ctx=None):
             r = FakeReq()
             for i, tok in enumerate(script):
                 emit(tok, i == len(script) - 1)

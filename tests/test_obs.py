@@ -142,6 +142,13 @@ def _parse_expo(text: str) -> dict[str, dict]:
             name = line.split()[2]
             families.setdefault(name, {"samples": []})["help"] = line
             declared = name
+        elif line.startswith("# EXEMPLAR "):
+            # Histogram trace exemplars ride as comment lines (any 0.0.4
+            # scraper ignores them); the golden parser pins their syntax.
+            m = re.match(
+                r'^# EXEMPLAR ([a-zA-Z_:][a-zA-Z0-9_:]*_bucket)(\{.*\})? '
+                r'trace_id="[0-9a-fA-F]*" value=\S+$', line)
+            assert m, f"malformed exemplar line: {line!r}"
         elif line.startswith("# TYPE "):
             _, _, name, kind = line.split(None, 3)
             assert name == declared, f"TYPE without preceding HELP: {line}"

@@ -234,7 +234,7 @@ def test_stream_deltas_survive_split_utf8_codepoint():
         _running = True   # consumer loop reads straight off the queue
 
         def submit(self, prompt, sp, emit=None, prefix_id=None,
-                   deadline_s=None):
+                   deadline_s=None, trace_ctx=None):
             r = FakeReq()
             for i, tok in enumerate(script):
                 emit(tok, i == len(script) - 1)
@@ -266,10 +266,10 @@ def test_ndjson_midstream_error_stays_in_band():
     class BoomCell:
         model_name = "boom"
 
-        def generate(self, req):
+        def generate(self, req, trace_ctx=None):
             raise AssertionError("non-stream path not under test")
 
-        def generate_stream(self, req):
+        def generate_stream(self, req, trace_ctx=None):
             yield {"token": 1, "text": "a"}
             yield {"token": 2, "text": "b"}
             raise RuntimeError("device lost mid-stream")

@@ -486,6 +486,59 @@ def test_kuke008_silent_when_documented(tmp_path):
     assert run_analysis(pkg, select=["KUKE008"]) == []
 
 
+# --- KUKE010: span phase registry --------------------------------------------
+
+PHASES_FIXTURE = '''
+    PHASES = (
+        "admitted",
+        "stale_phase",
+    )
+'''
+
+
+def test_kuke010_flags_undeclared_stale_and_dynamic(tmp_path):
+    pkg = _mini_repo(tmp_path, {
+        "obs/trace.py": PHASES_FIXTURE,
+        "mod.py": '''
+            from pkg import sanitize
+
+            def f(span, name):
+                span.event("admitted")          # declared: fine
+                span.event("mystery_phase")     # undeclared
+                span.event(name)                # dynamic
+                halt = sanitize.event("Cls._halt")   # Event factory: exempt
+        ''',
+    })
+    found = run_analysis(pkg, select=["KUKE010"])
+    details = sorted(f.detail for f in found)
+    assert details == ["<dynamic>", "mystery_phase", "stale_phase"]
+
+
+def test_kuke010_silent_when_registry_matches(tmp_path):
+    pkg = _mini_repo(tmp_path, {
+        "obs/trace.py": '''
+            PHASES = ("admitted",)
+        ''',
+        "mod.py": '''
+            def f(span):
+                span.event("admitted")
+        ''',
+    })
+    assert run_analysis(pkg, select=["KUKE010"]) == []
+
+
+def test_kuke010_silent_without_a_trace_module(tmp_path):
+    # Fixture repos with no obs/trace.py must not be forced to declare a
+    # registry just because something has an .event method.
+    pkg = _mini_repo(tmp_path, {
+        "mod.py": '''
+            def f(span):
+                span.event("whatever")
+        ''',
+    })
+    assert run_analysis(pkg, select=["KUKE010"]) == []
+
+
 # --- baseline suppression ----------------------------------------------------
 
 
@@ -566,6 +619,7 @@ def test_all_rules_are_registered():
     assert registered_rules() == (
         "KUKE001", "KUKE002", "KUKE003", "KUKE004",
         "KUKE005", "KUKE006", "KUKE007", "KUKE008", "KUKE009",
+        "KUKE010",
     )
 
 
