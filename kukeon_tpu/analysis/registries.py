@@ -27,6 +27,13 @@ kukeon_tpu.analysis`` and inside tier-1 via tests/test_static_analysis.py.
   phase names must be literals — same contract shape as KUKE007.
   ``sanitize.event(...)`` (the named-threading.Event factory) is the one
   same-named API and is excluded by its receiver.
+- **KUKE011 — alert rules vs the metric registry.** Every metric family
+  a built-in alert rule (``obs/alerts.py`` ``Rule(...)`` expressions)
+  references must exist as a declared metric family elsewhere in the
+  package — a renamed metric would otherwise leave a silently dead
+  alert that never fires. Dynamic (non-literal) rule expressions are
+  themselves findings: the registry can only be checked against
+  literals.
 """
 
 from __future__ import annotations
@@ -224,6 +231,104 @@ def check_phase_registry(sources: Sequence[SourceFile],
                 f".event(\"{phase}\") call site exists — remove the "
                 f"stale declaration",
                 scope="PHASES", detail=phase))
+    return findings
+
+
+ALERTS_MODULE_SUFFIX = "obs/alerts.py"
+# One rule expression is a selector, or `selector / selector`; a family
+# name is the identifier each selector leads with.
+_EXPR_FAMILY_RE = re.compile(r"^\s*([a-zA-Z_:][a-zA-Z0-9_:]*)")
+
+
+def expr_families(expr: str) -> list[str]:
+    """Metric family names an alert-rule expression references: the
+    leading identifier of each top-level '/'-separated selector."""
+    out: list[str] = []
+    depth = 0
+    part_start = 0
+    parts: list[str] = []
+    for i, ch in enumerate(expr):
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+        elif ch == "/" and depth == 0:
+            parts.append(expr[part_start:i])
+            part_start = i + 1
+    parts.append(expr[part_start:])
+    for part in parts:
+        m = _EXPR_FAMILY_RE.match(part)
+        if m:
+            out.append(m.group(1))
+    return out
+
+
+def collect_alert_rule_exprs(sources: Sequence[SourceFile]) -> list[
+        tuple[str, str | None, str | None, int]]:
+    """(file, rule name, expr-or-None-if-dynamic, line) for every
+    ``Rule(...)`` construction in the alerts module (the built-in rule
+    set lives there; user rules are validated at load time instead)."""
+    out: list[tuple[str, str | None, str | None, int]] = []
+    for src in sources:
+        if not src.rel.endswith(ALERTS_MODULE_SUFFIX):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name != "Rule":
+                continue
+            expr = rule_name = None
+            for kw in node.keywords:
+                if kw.arg == "expr":
+                    expr = const_str(kw.value)
+                    if expr is None:
+                        expr = "<dynamic>"
+                elif kw.arg == "name":
+                    rule_name = const_str(kw.value)
+            if len(node.args) > 1 and expr is None:
+                expr = const_str(node.args[1]) or "<dynamic>"
+            if node.args and rule_name is None:
+                rule_name = const_str(node.args[0])
+            if expr is not None:
+                out.append((src.rel,
+                            rule_name,
+                            None if expr == "<dynamic>" else expr,
+                            node.lineno))
+    return out
+
+
+@register_pass(("KUKE011",))
+def check_alert_rule_families(sources: Sequence[SourceFile],
+                              package_root: str) -> list[Finding]:
+    exprs = collect_alert_rule_exprs(sources)
+    if not exprs:
+        return []    # no alerts module in this tree (fixture packages)
+    # The declared registry: every metric-family literal OUTSIDE the
+    # alerts module (a rule's own expr string must not satisfy itself).
+    declared = set(collect_metric_literals(
+        [s for s in sources if not s.rel.endswith(ALERTS_MODULE_SUFFIX)]))
+    findings: list[Finding] = []
+    for rel, rule_name, expr, line in exprs:
+        scope = rule_name or "?"
+        if expr is None:
+            findings.append(Finding(
+                "KUKE011", rel, line,
+                f"alert rule {scope!r} has a non-literal expression: the "
+                f"metric registry can only be checked against literal "
+                f"family names — inline the expression",
+                scope=scope, detail="<dynamic>"))
+            continue
+        for fam in expr_families(expr):
+            if fam not in declared:
+                findings.append(Finding(
+                    "KUKE011", rel, line,
+                    f"alert rule {scope!r} references metric family "
+                    f"\"{fam}\" which no module in the package declares "
+                    f"— the rule can never fire",
+                    scope=scope, detail=fam))
     return findings
 
 

@@ -42,3 +42,18 @@ from kukeon_tpu.obs.device import (  # noqa: F401
     device_memory_collector,
 )
 from kukeon_tpu.obs.slo import SloObjectives, SloTracker  # noqa: F401
+from kukeon_tpu.obs.tsdb import (  # noqa: F401
+    AGGS,
+    TSDB,
+    parse_expr,
+    parse_selector,
+    parse_window,
+    sparkline,
+)
+from kukeon_tpu.obs.alerts import (  # noqa: F401
+    BUILTIN_RULES,
+    AlertEngine,
+    Rule,
+    load_user_rules,
+    validate_rule,
+)

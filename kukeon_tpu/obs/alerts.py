@@ -1,0 +1,440 @@
+"""Declarative alerting over the in-daemon TSDB (obs/tsdb.py).
+
+A rule is a windowed query plus a threshold and a ``for:`` duration; the
+daemon's telemetry loop evaluates every rule each scrape tick and runs a
+pending -> firing -> resolved state machine **per result labelset** (an
+alert on ``kukeon_slo_burn_rate`` fires per cell, not once for the fleet).
+
+Semantics, pinned by tests:
+
+- A breach first moves the labelset to **pending**; it becomes **firing**
+  only once the breach has held for ``for_s`` (``for_s=0`` fires on the
+  first breaching tick). Pending never fires early, and a breach that
+  clears while pending cancels silently — that near-miss is visible in
+  `kuke alerts` state but produces no transition noise.
+- A firing labelset whose condition clears (or whose series ages out of
+  the window entirely — a deleted cell resolves its own alerts) emits a
+  **resolved** transition.
+- Transitions are structured events: JSON-logged (``alert``, ``severity``,
+  ``cell``, and the cell's latest TTFT exemplar ``trace_id`` when the rule
+  declares an exemplar family — an SLO page links straight to a
+  reconstructable `kuke trace`), appended to a bounded ring for
+  `kuke alerts`, optionally POSTed to ``KUKEON_ALERT_WEBHOOK``, and the
+  firing census is exported as ``kukeon_alerts_firing{alert,severity}``
+  (every known rule declared at 0 so "nothing firing" is an observable 0,
+  not an absent family).
+
+Built-in rules cover the failure modes the runtime already measures:
+SLO burn (fast + slow window), container restart loops, HBM pressure,
+queue saturation, cell scrape-down, and cold-start regression against the
+ROADMAP 90s target. ``KUKEON_ALERT_RULES`` adds operator rules (a JSON/
+YAML file path or an inline document), validated field-by-field — a typo'd
+rule is a loud error, never a silently dead alert. kukelint's KUKE011
+keeps every built-in rule's metric families honest against the declared
+registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from kukeon_tpu import sanitize
+from kukeon_tpu.obs import tsdb as tsdb_mod
+
+RULES_ENV = "KUKEON_ALERT_RULES"
+WEBHOOK_ENV = "KUKEON_ALERT_WEBHOOK"
+WEBHOOK_TIMEOUT_S = 2.0
+
+SEVERITIES = ("info", "warning", "critical")
+OPS = (">", "<")
+
+log = logging.getLogger("kukeon.alerts")
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One declarative alert: fire when ``agg(expr)`` over ``window_s``
+    compares ``op`` against ``threshold`` for at least ``for_s``."""
+
+    name: str
+    expr: str
+    agg: str
+    window_s: float
+    op: str
+    threshold: float
+    for_s: float = 0.0
+    severity: str = "warning"
+    description: str = ""
+    # Histogram family whose latest exemplar (per cell) decorates this
+    # rule's transitions with a reconstructable trace id.
+    exemplar_family: str | None = None
+
+
+# The failure modes the runtime already measures, alerted on by default.
+# KUKE011 (kukelint) checks every family referenced here against the
+# package's declared metric registry, so a renamed metric cannot leave a
+# silently dead rule behind.
+BUILTIN_RULES: tuple[Rule, ...] = (
+    Rule(name="SloBurnFast",
+         expr="kukeon_slo_burn_rate{window=5m}",
+         agg="max", window_s=60.0, op=">", threshold=10.0, for_s=0.0,
+         severity="critical",
+         description="short-window SLO burn: the error budget is burning "
+                     ">=10x faster than allowed (deadline storm, crash "
+                     "loop, or latency collapse)",
+         exemplar_family="kukeon_engine_ttft_seconds"),
+    Rule(name="SloBurnSlow",
+         expr="kukeon_slo_burn_rate{window=1h}",
+         agg="avg", window_s=300.0, op=">", threshold=1.0, for_s=120.0,
+         severity="warning",
+         description="sustained SLO burn: the long-window budget is "
+                     "burning faster than allowed",
+         exemplar_family="kukeon_engine_ttft_seconds"),
+    Rule(name="ContainerRestartLoop",
+         expr="kukeon_runner_container_restarts_total",
+         agg="delta", window_s=600.0, op=">", threshold=3.0, for_s=0.0,
+         severity="critical",
+         description="a container restarted >3 times in 10m — crash loop "
+                     "(exit 86 = watchdog-declared wedge)"),
+    Rule(name="HbmPressure",
+         expr="kukeon_hbm_bytes_in_use / kukeon_hbm_bytes_limit",
+         agg="max", window_s=120.0, op=">", threshold=0.92, for_s=60.0,
+         severity="warning",
+         description="device HBM above 92% of capacity — next admission "
+                     "may OOM or force preemptions"),
+    Rule(name="QueueSaturation",
+         expr="kukeon_engine_queue_depth / kukeon_engine_max_pending",
+         agg="avg", window_s=120.0, op=">", threshold=0.9, for_s=60.0,
+         severity="warning",
+         description="admission queue above 90% of max_pending — sheds "
+                     "are imminent"),
+    Rule(name="CellScrapeDown",
+         expr="kukeon_cell_scrape_ok",
+         agg="max", window_s=60.0, op="<", threshold=0.5, for_s=30.0,
+         severity="critical",
+         description="the federated scrape has not reached this cell for "
+                     "a full window — down, not merely flapping"),
+    Rule(name="ColdStartRegression",
+         expr="kukeon_cold_start_seconds",
+         agg="max", window_s=3600.0, op=">", threshold=90.0, for_s=0.0,
+         severity="warning",
+         description="a cell boot exceeded the 90s cold-start target "
+                     "(rolling restarts and autoscaling assume it)"),
+)
+
+_RULE_FIELDS = {f.name for f in dataclasses.fields(Rule)}
+# Spelling used in user-facing JSON/YAML documents.
+_USER_KEYS = {"for": "for_s", "window": "window_s"}
+
+
+def validate_rule(obj: object) -> Rule:
+    """One user rule document -> Rule, with every problem named."""
+    if not isinstance(obj, dict):
+        raise ValueError(f"alert rule must be a mapping, got {type(obj).__name__}")
+    raw = {}
+    for k, v in obj.items():
+        key = _USER_KEYS.get(k, k)
+        if key not in _RULE_FIELDS:
+            raise ValueError(f"alert rule has unknown field {k!r}")
+        raw[key] = v
+    for req in ("name", "expr", "agg", "window_s", "op", "threshold"):
+        if req not in raw:
+            raise ValueError(
+                f"alert rule {raw.get('name', '?')!r} is missing "
+                f"required field {req!r}")
+    if not isinstance(raw["name"], str) or not raw["name"]:
+        raise ValueError("alert rule name must be a non-empty string")
+    name = raw["name"]
+    if raw["agg"] not in tsdb_mod.AGGS:
+        raise ValueError(
+            f"alert rule {name!r}: agg {raw['agg']!r} not in "
+            f"{', '.join(tsdb_mod.AGGS)}")
+    if raw["op"] not in OPS:
+        raise ValueError(f"alert rule {name!r}: op must be one of {OPS}")
+    if raw.get("severity", "warning") not in SEVERITIES:
+        raise ValueError(
+            f"alert rule {name!r}: severity must be one of {SEVERITIES}")
+    try:
+        raw["window_s"] = tsdb_mod.parse_window(raw["window_s"])
+    except ValueError as e:
+        raise ValueError(f"alert rule {name!r}: {e}") from None
+    if "for_s" in raw:
+        try:
+            raw["for_s"] = (0.0 if raw["for_s"] in (0, "0")
+                            else tsdb_mod.parse_window(raw["for_s"]))
+        except ValueError as e:
+            raise ValueError(f"alert rule {name!r}: {e}") from None
+    try:
+        raw["threshold"] = float(raw["threshold"])
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"alert rule {name!r}: threshold must be a number") from None
+    try:
+        tsdb_mod.parse_expr(raw["expr"])
+    except ValueError as e:
+        raise ValueError(f"alert rule {name!r}: {e}") from None
+    return Rule(**raw)
+
+
+def load_user_rules(spec: str | None = None) -> tuple[Rule, ...]:
+    """``KUKEON_ALERT_RULES`` (or an explicit spec) -> validated rules.
+
+    The spec is an inline JSON/YAML document when it starts with ``[`` or
+    ``{``, else a path to a file holding one. The document is a list of
+    rule mappings (a single mapping is accepted as a list of one)."""
+    if spec is None:
+        spec = os.environ.get(RULES_ENV, "")
+    spec = spec.strip()
+    if not spec:
+        return ()
+    if spec.startswith("[") or spec.startswith("{"):
+        text, origin = spec, "inline " + RULES_ENV
+    else:
+        try:
+            with open(spec, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            raise ValueError(f"cannot read {RULES_ENV} file {spec!r}: {e}"
+                             ) from None
+        origin = spec
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        try:
+            import yaml
+        except ImportError:
+            raise ValueError(
+                f"{origin} is not valid JSON and no yaml module is "
+                f"available") from None
+        try:
+            doc = yaml.safe_load(text)
+        except yaml.YAMLError as e:
+            raise ValueError(f"{origin} is not valid JSON or YAML: {e}"
+                             ) from None
+    if isinstance(doc, dict):
+        doc = [doc]
+    if not isinstance(doc, list):
+        raise ValueError(
+            f"{origin} must hold a list of alert rules, got "
+            f"{type(doc).__name__}")
+    rules = tuple(validate_rule(obj) for obj in doc)
+    seen: set[str] = set()
+    for r in rules:
+        if r.name in seen or any(r.name == b.name for b in BUILTIN_RULES):
+            raise ValueError(f"duplicate alert rule name {r.name!r}")
+        seen.add(r.name)
+    return rules
+
+
+class _Active:
+    __slots__ = ("state", "since", "firing_since", "value", "labels")
+
+    def __init__(self, since: float, labels: dict[str, str], value: float):
+        self.state = "pending"
+        self.since = since
+        self.firing_since: float | None = None
+        self.value = value
+        self.labels = labels
+
+
+class AlertEngine:
+    """Evaluates rules against the TSDB each telemetry tick and keeps the
+    per-labelset state machines, the transition ring, and the firing
+    gauge. Thread-safe: evaluation runs on the daemon's telemetry thread
+    while `kuke alerts` reads state from RPC handler threads."""
+
+    def __init__(self, tsdb: tsdb_mod.TSDB,
+                 rules: tuple[Rule, ...] = BUILTIN_RULES,
+                 registry=None,
+                 clock: Callable[[], float] = time.time,
+                 webhook_url: str | None = None,
+                 max_transitions: int = 256):
+        self._tsdb = tsdb
+        self._rules = tuple(rules)
+        self._clock = clock
+        self._webhook_url = (webhook_url if webhook_url is not None
+                             else os.environ.get(WEBHOOK_ENV) or None)
+        self._lock = sanitize.lock("AlertEngine._lock")
+        self._active: dict[tuple[str, tuple[tuple[str, str], ...]],
+                           _Active] = {}
+        self._transitions: deque[dict] = deque(maxlen=max_transitions)
+        self._m_firing = None
+        self._m_webhook = None
+        if registry is not None:
+            self._m_firing = registry.gauge(
+                "kukeon_alerts_firing",
+                "Labelsets currently firing per alert rule (0 = healthy).",
+                labels=("alert", "severity"))
+            for r in self._rules:
+                self._m_firing.set(0, alert=r.name, severity=r.severity)
+            self._m_webhook = registry.counter(
+                "kukeon_alerts_webhook_total",
+                "Alert-transition webhook POSTs by result.",
+                labels=("result",))
+
+    @property
+    def rules(self) -> tuple[Rule, ...]:
+        return self._rules
+
+    # --- evaluation -----------------------------------------------------------
+
+    def evaluate(self, at: float | None = None) -> list[dict]:
+        """One tick: query every rule, advance the state machines, emit
+        transitions. Queries run before the engine lock is taken (the
+        TSDB has its own lock; holding both across the query would nest
+        them for no reason), and side effects (log/webhook/gauge) run
+        after it is released."""
+        now = self._clock() if at is None else at
+        results: list[tuple[Rule, list[tuple[dict[str, str], float]]]] = []
+        for rule in self._rules:
+            try:
+                results.append((rule, self._tsdb.query(
+                    rule.expr, rule.window_s, rule.agg, at=now)))
+            except ValueError as e:  # a bad rule must not kill the loop
+                log.warning("alert rule %s query failed: %s", rule.name, e)
+                results.append((rule, []))
+        transitions: list[dict] = []
+        with self._lock:
+            for rule, series in results:
+                breached = {
+                    tuple(sorted(labels.items())): (labels, value)
+                    for labels, value in series
+                    if (value > rule.threshold if rule.op == ">"
+                        else value < rule.threshold)
+                }
+                for key, (labels, value) in breached.items():
+                    st = self._active.get((rule.name, key))
+                    if st is None:
+                        st = self._active[(rule.name, key)] = _Active(
+                            now, labels, value)
+                    st.value = value
+                    st.labels = labels
+                    if (st.state == "pending"
+                            and now - st.since >= rule.for_s):
+                        st.state = "firing"
+                        st.firing_since = now
+                        transitions.append(self._transition(
+                            rule, "firing", now, labels, value))
+                for (rname, key) in [
+                        k for k in self._active if k[0] == rule.name]:
+                    if key in breached:
+                        continue
+                    st = self._active.pop((rname, key))
+                    if st.state == "firing":
+                        transitions.append(self._transition(
+                            rule, "resolved", now, st.labels, st.value))
+                    # A pending labelset that clears cancels silently.
+            for tr in transitions:
+                self._transitions.append(tr)
+            firing_counts: dict[tuple[str, str], int] = {}
+            for (rname, _key), st in self._active.items():
+                if st.state != "firing":
+                    continue
+                rule = next(r for r in self._rules if r.name == rname)
+                firing_counts[(rname, rule.severity)] = firing_counts.get(
+                    (rname, rule.severity), 0) + 1
+        if self._m_firing is not None:
+            for r in self._rules:
+                self._m_firing.set(
+                    firing_counts.get((r.name, r.severity), 0),
+                    alert=r.name, severity=r.severity)
+        for tr in transitions:
+            self._emit(tr)
+        return transitions
+
+    def _transition(self, rule: Rule, state: str, at: float,
+                    labels: dict[str, str], value: float) -> dict:
+        tr = {
+            "alert": rule.name,
+            "severity": rule.severity,
+            "state": state,
+            "at": at,
+            "labels": dict(labels),
+            "value": value,
+            "expr": rule.expr,
+            "threshold": rule.threshold,
+            "description": rule.description,
+        }
+        cell = labels.get("cell")
+        if cell:
+            tr["cell"] = cell
+            if rule.exemplar_family:
+                ex = self._tsdb.latest_exemplar(rule.exemplar_family,
+                                                cell=cell)
+                if ex is not None:
+                    tr["trace_id"] = ex[0]
+        return tr
+
+    def _emit(self, tr: dict) -> None:
+        level = (logging.WARNING if tr["state"] == "firing"
+                 else logging.INFO)
+        log.log(level, "alert %s %s (value %.4g %s %.4g)%s",
+                tr["alert"], tr["state"], tr["value"],
+                "breaching" if tr["state"] == "firing" else "vs",
+                tr["threshold"],
+                f" cell={tr['cell']}" if tr.get("cell") else "",
+                extra={"alert": tr["alert"], "severity": tr["severity"],
+                       "cell": tr.get("cell"),
+                       "trace_id": tr.get("trace_id"),
+                       "outcome": tr["state"]})
+        if self._webhook_url:
+            threading.Thread(target=self._post_webhook, args=(tr,),
+                             daemon=True, name="alert-webhook").start()
+
+    def _post_webhook(self, tr: dict) -> None:
+        import urllib.request
+        try:
+            req = urllib.request.Request(
+                self._webhook_url, data=json.dumps(tr).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=WEBHOOK_TIMEOUT_S):
+                pass
+            if self._m_webhook is not None:
+                self._m_webhook.inc(result="ok")
+        except Exception as e:  # noqa: BLE001 — a dead webhook must not matter
+            log.warning("alert webhook POST failed: %s", e)
+            if self._m_webhook is not None:
+                self._m_webhook.inc(result="error")
+
+    # --- views ----------------------------------------------------------------
+
+    def states(self) -> list[dict]:
+        """One row per rule (state ``ok`` when nothing is active) plus one
+        per active labelset — the `kuke alerts` table."""
+        with self._lock:
+            active = [
+                {"alert": rname, "labels": dict(st.labels),
+                 "state": st.state, "since": st.since,
+                 "firingSince": st.firing_since, "value": st.value}
+                for (rname, _key), st in sorted(
+                    self._active.items(), key=lambda kv: kv[0])
+            ]
+        by_rule: dict[str, list[dict]] = {}
+        for row in active:
+            by_rule.setdefault(row["alert"], []).append(row)
+        out: list[dict] = []
+        for rule in self._rules:
+            rows = by_rule.get(rule.name)
+            if not rows:
+                out.append({"alert": rule.name, "severity": rule.severity,
+                            "state": "ok", "expr": rule.expr,
+                            "threshold": rule.threshold,
+                            "description": rule.description})
+                continue
+            for row in rows:
+                out.append({**row, "severity": rule.severity,
+                            "expr": rule.expr,
+                            "threshold": rule.threshold,
+                            "description": rule.description})
+        return out
+
+    def transitions(self, n: int = 50) -> list[dict]:
+        with self._lock:
+            return list(self._transitions)[-int(n):]

@@ -1,0 +1,494 @@
+"""In-daemon time-series store: bounded history for the federated scrape.
+
+PR 4's federation made one scrape see the whole fleet — and forget it the
+moment `kuke top` rendered. This module is the memory: the daemon's
+telemetry loop ingests every cell's parsed /metrics exposition into
+per-series rings so windowed questions ("TTFT p95 over the last 5
+minutes", "is this replica crash-looping") have an answer without any
+external Prometheus. The alert engine (obs/alerts.py) and the autoscaler's
+future reconcile loop read the same store.
+
+Design constraints:
+
+- **Zero dependencies, bounded memory.** A series is a deque of
+  ``(unix_ts, value)`` pairs trimmed to ``KUKEON_TSDB_RETENTION_S``
+  (default 1h) on every append; series that stop updating are GC'd after
+  one retention window; the series *count* is hard-capped
+  (``KUKEON_TSDB_MAX_SERIES``) — past the cap new series are dropped and
+  counted, never silently absorbed into unbounded growth.
+- **Thread-safe, never blocking under the lock.** Ingest builds its rows
+  from the parsed families entirely outside the store lock and only
+  appends under it; queries snapshot the matching rings under the lock
+  and do all math outside. The whole suite runs clean under
+  ``KUKEON_SANITIZE=1``.
+- **Counter-reset aware.** A cell restart mid-window drops its cumulative
+  counters to ~0; a reset-oblivious delta would go negative and a rate
+  would dip below zero. Monotonic series (counters and histogram
+  ``_bucket``/``_sum``/``_count`` children) accumulate increase as
+  ``v1 - v0`` when monotone and ``v1`` after a drop (the post-reset value
+  IS the increase since the reset).
+- **Histogram aware.** ``p50/p95/p99`` aggregations reconstruct windowed
+  per-bucket deltas from the cumulative ``_bucket`` series (per-``le``
+  reset detection, negatives clamped) and feed the exact estimator the
+  live registry uses (:func:`obs.percentile_from_counts`) — same
+  log-spaced ladder, same interpolation, so a windowed p95 and the cell's
+  own since-boot p95 agree to within a bucket.
+
+Query language: ``family{label=value,label2="value 2"}`` with optional
+aggregations ``rate | delta | avg | max | min | latest | p50 | p95 |
+p99``, plus a single top-level ``/`` for label-joined ratios
+(``kukeon_hbm_bytes_in_use / kukeon_hbm_bytes_limit``). Deliberately not
+PromQL — just enough for `kuke query`, the alert rules, and sparklines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import time
+from collections import deque
+from typing import Callable, Iterable
+
+from kukeon_tpu import sanitize
+from kukeon_tpu.obs.registry import percentile_from_counts
+
+RETENTION_ENV = "KUKEON_TSDB_RETENTION_S"
+DEFAULT_RETENTION_S = 3600.0
+MAX_SERIES_ENV = "KUKEON_TSDB_MAX_SERIES"
+DEFAULT_MAX_SERIES = 8192
+
+#: Supported aggregations, in the order `kuke query --help` lists them.
+AGGS = ("rate", "delta", "avg", "max", "min", "latest", "p50", "p95", "p99")
+
+_QUANTILES = {"p50": 0.50, "p95": 0.95, "p99": 0.99}
+
+_SELECTOR_RE = re.compile(
+    r"^\s*([a-zA-Z_:][a-zA-Z0-9_:]*)\s*(\{(.*)\})?\s*$")
+_LABEL_PAIR_RE = re.compile(
+    r'\s*([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*(?:"((?:[^"\\]|\\.)*)"|([^,{}"\s]+))\s*(?:,|$)')
+_WINDOW_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*(ms|s|m|h|d)?\s*$")
+_SUFFIX_RE = re.compile(r"_(bucket|sum|count)$")
+
+_WINDOW_MULT = {"ms": 0.001, "s": 1.0, None: 1.0, "m": 60.0, "h": 3600.0,
+                "d": 86400.0}
+
+_LabelItems = tuple[tuple[str, str], ...]
+
+
+def parse_window(text: "str | float | int") -> float:
+    """``"30s" | "5m" | "1h" | "250ms" | 300`` -> seconds (float > 0)."""
+    if isinstance(text, (int, float)):
+        if text <= 0:
+            raise ValueError(f"window must be positive, got {text!r}")
+        return float(text)
+    m = _WINDOW_RE.match(str(text))
+    if not m:
+        raise ValueError(
+            f"bad window {text!r} (want a duration like 30s, 5m, 1h)")
+    out = float(m.group(1)) * _WINDOW_MULT[m.group(2)]
+    if out <= 0:
+        raise ValueError(f"window must be positive, got {text!r}")
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Selector:
+    """One parsed ``family{label=value,...}`` term."""
+
+    family: str
+    matchers: _LabelItems = ()
+
+    def matches(self, labels: dict[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in self.matchers)
+
+
+def parse_selector(text: str) -> Selector:
+    m = _SELECTOR_RE.match(text)
+    if not m:
+        raise ValueError(
+            f"bad selector {text!r} (want family or family{{label=value}})")
+    inner = m.group(3)
+    matchers: list[tuple[str, str]] = []
+    if inner is not None and inner.strip():
+        pos = 0
+        while pos < len(inner):
+            pm = _LABEL_PAIR_RE.match(inner, pos)
+            if pm is None:
+                raise ValueError(
+                    f"bad label matcher in {text!r} at {inner[pos:]!r} "
+                    f'(want label=value or label="value")')
+            matchers.append((pm.group(1),
+                             pm.group(2) if pm.group(2) is not None
+                             else pm.group(3)))
+            pos = pm.end()
+    return Selector(m.group(1), tuple(sorted(matchers)))
+
+
+def parse_expr(text: str) -> tuple[Selector, Selector | None]:
+    """An expression is one selector, or ``selector / selector`` (the
+    label-joined ratio). The split is on a top-level ``/`` only — never
+    inside ``{...}``."""
+    depth = 0
+    split_at = None
+    for i, ch in enumerate(text):
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+        elif ch == "/" and depth == 0:
+            if split_at is not None:
+                raise ValueError(
+                    f"at most one '/' in a query expression: {text!r}")
+            split_at = i
+    if split_at is None:
+        return parse_selector(text), None
+    return (parse_selector(text[:split_at]),
+            parse_selector(text[split_at + 1:]))
+
+
+class _Series:
+    __slots__ = ("monotonic", "points", "last_at")
+
+    def __init__(self, monotonic: bool):
+        self.monotonic = monotonic
+        self.points: deque[tuple[float, float]] = deque()
+        self.last_at = 0.0
+
+
+def _increase(points: list[tuple[float, float]], monotonic: bool,
+              start: float, end: float) -> float | None:
+    """Reset-aware increase over ``(start, end]``: consecutive-pair sums
+    with the last at-or-before-``start`` point as the baseline. ``None``
+    when the series has no point inside the range (stale series)."""
+    baseline = None
+    seq: list[tuple[float, float]] = []
+    for t, v in points:
+        if t <= start:
+            baseline = (t, v)
+        elif t <= end:
+            seq.append((t, v))
+    if not seq:
+        return None
+    if baseline is not None:
+        seq.insert(0, baseline)
+    inc = 0.0
+    for (_, v0), (_, v1) in zip(seq, seq[1:]):
+        if not monotonic:
+            inc += v1 - v0
+        elif v1 >= v0:
+            inc += v1 - v0
+        else:
+            # Counter reset (cell restart): the post-reset cumulative
+            # value is itself the increase since the reset.
+            inc += v1
+    return inc
+
+
+def _agg_window(points: list[tuple[float, float]], monotonic: bool,
+                agg: str, start: float, end: float) -> float | None:
+    if agg in ("rate", "delta"):
+        inc = _increase(points, monotonic, start, end)
+        if inc is None:
+            return None
+        return inc / max(end - start, 1e-9) if agg == "rate" else inc
+    vals = [v for t, v in points if start < t <= end]
+    if not vals:
+        return None
+    if agg == "avg":
+        return sum(vals) / len(vals)
+    if agg == "max":
+        return max(vals)
+    if agg == "min":
+        return min(vals)
+    if agg == "latest":
+        return vals[-1]
+    raise ValueError(f"unknown aggregation {agg!r} (want one of {AGGS})")
+
+
+class TSDB:
+    """The bounded in-daemon store: per-series rings keyed by
+    (sample name, sorted labels), fed by the telemetry loop, read by
+    `kuke query`, the alert engine, and `kuke top --watch` sparklines."""
+
+    def __init__(self, retention_s: float | None = None,
+                 max_series: int | None = None,
+                 clock: Callable[[], float] = time.time):
+        if retention_s is None:
+            retention_s = float(
+                os.environ.get(RETENTION_ENV, "") or DEFAULT_RETENTION_S)
+        if max_series is None:
+            max_series = int(
+                os.environ.get(MAX_SERIES_ENV, "") or DEFAULT_MAX_SERIES)
+        if retention_s <= 0:
+            raise ValueError("retention must be positive")
+        self.retention_s = float(retention_s)
+        self.max_series = int(max_series)
+        self._clock = clock
+        self._lock = sanitize.lock("TSDB._lock")
+        self._series: dict[tuple[str, _LabelItems], _Series] = {}
+        # (family, labels-without-le) -> (trace_id, value, at): the last
+        # exemplar seen per histogram labelset, so an alert transition can
+        # name a reconstructable trace for its cell.
+        self._exemplars: dict[tuple[str, _LabelItems],
+                              tuple[str, float, float]] = {}
+        self._dropped = 0
+        self._ingested = 0
+
+    # --- ingest ---------------------------------------------------------------
+
+    def ingest(self, families: dict, at: float | None = None) -> None:
+        """Append one scrape's parsed families (``federate.parse`` output,
+        already relabelled with ``cell=``). Rows are built entirely outside
+        the store lock; the lock covers only the appends and the eviction
+        sweep."""
+        if at is None:
+            at = self._clock()
+        rows: list[tuple[str, _LabelItems, bool, float]] = []
+        exemplars: list[tuple[str, _LabelItems, str, float]] = []
+        for fam in families.values():
+            kind = getattr(fam, "kind", "untyped")
+            for name, labels, value in fam.samples:
+                try:
+                    v = float(value)
+                except (TypeError, ValueError):
+                    continue
+                monotonic = kind == "counter" or (
+                    kind == "histogram" and bool(_SUFFIX_RE.search(name)))
+                rows.append(
+                    (name, tuple(sorted(labels.items())), monotonic, v))
+            for name, labels, trace_id, value in getattr(
+                    fam, "exemplars", ()):
+                if not trace_id:
+                    continue
+                base = _SUFFIX_RE.sub("", name)
+                lab = {k: v for k, v in labels.items() if k != "le"}
+                try:
+                    exemplars.append((base, tuple(sorted(lab.items())),
+                                      trace_id, float(value)))
+                except (TypeError, ValueError):
+                    continue
+        horizon = at - self.retention_s
+        with self._lock:
+            for name, key, monotonic, v in rows:
+                s = self._series.get((name, key))
+                if s is None:
+                    if len(self._series) >= self.max_series:
+                        self._dropped += 1
+                        continue
+                    s = self._series[(name, key)] = _Series(monotonic)
+                s.points.append((at, v))
+                s.last_at = max(s.last_at, at)
+                while s.points and s.points[0][0] < horizon:
+                    s.points.popleft()
+            for base, key, trace_id, v in exemplars:
+                self._exemplars[(base, key)] = (trace_id, v, at)
+            # GC: series (and exemplars) nothing has updated for a full
+            # retention window — a deleted cell must not pin memory.
+            for k in [k for k, s in self._series.items()
+                      if s.last_at < horizon]:
+                del self._series[k]
+            for k in [k for k, (_t, _v, ex_at) in self._exemplars.items()
+                      if ex_at < horizon]:
+                del self._exemplars[k]
+            self._ingested += 1
+
+    # --- introspection --------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "series": len(self._series),
+                "points": sum(len(s.points)
+                              for s in self._series.values()),
+                "droppedSeries": self._dropped,
+                "ingests": self._ingested,
+            }
+
+    def latest_exemplar(self, family: str,
+                        **match: str) -> tuple[str, float, float] | None:
+        """Most recent (trace_id, value, at) exemplar for a histogram
+        family whose labels include ``match``."""
+        want = {k: str(v) for k, v in match.items()}
+        best: tuple[str, float, float] | None = None
+        with self._lock:
+            for (fam, key), rec in self._exemplars.items():
+                if fam != family:
+                    continue
+                labels = dict(key)
+                if any(labels.get(k) != v for k, v in want.items()):
+                    continue
+                if best is None or rec[2] > best[2]:
+                    best = rec
+        return best
+
+    # --- queries --------------------------------------------------------------
+
+    def _snapshot(self, name: str, matchers: _LabelItems
+                  ) -> list[tuple[_LabelItems, bool, list[tuple[float, float]]]]:
+        want = dict(matchers)
+        out = []
+        with self._lock:
+            for (n, key), s in self._series.items():
+                if n != name:
+                    continue
+                labels = dict(key)
+                if any(labels.get(k) != v for k, v in want.items()):
+                    continue
+                out.append((key, s.monotonic, list(s.points)))
+        return out
+
+    def _eval_quantile(self, sel: Selector, q: float, start: float,
+                       end: float) -> list[tuple[dict[str, str], float]]:
+        groups: dict[_LabelItems, dict[str, float]] = {}
+        for key, _mono, pts in self._snapshot(sel.family + "_bucket",
+                                              sel.matchers):
+            labels = dict(key)
+            le = labels.pop("le", None)
+            if le is None:
+                continue
+            inc = _increase(pts, True, start, end)
+            if inc is None:
+                continue
+            groups.setdefault(tuple(sorted(labels.items())), {})[le] = inc
+        out: list[tuple[dict[str, str], float]] = []
+        for key, les in groups.items():
+            finite = sorted((float(le), inc) for le, inc in les.items()
+                            if le != "+Inf")
+            if not finite:
+                continue
+            bounds = tuple(le for le, _ in finite)
+            counts: list[int] = []
+            prev = 0.0
+            for _le, cum in finite:
+                # Clamp: per-le reset adjustment can leave a cumulative
+                # sequence locally non-monotone; a negative bucket count
+                # would poison the estimator.
+                counts.append(max(0, int(round(cum - prev))))
+                prev = max(prev, cum)
+            counts.append(max(0, int(round(les.get("+Inf", prev) - prev))))
+            v = percentile_from_counts(bounds, counts, q)
+            if v is not None:
+                out.append((dict(key), v))
+        return out
+
+    def _eval(self, sel: Selector, agg: str, start: float,
+              end: float) -> list[tuple[dict[str, str], float]]:
+        if agg in _QUANTILES:
+            return self._eval_quantile(sel, _QUANTILES[agg], start, end)
+        if agg not in AGGS:
+            raise ValueError(f"unknown aggregation {agg!r} "
+                             f"(want one of {', '.join(AGGS)})")
+        out: list[tuple[dict[str, str], float]] = []
+        for key, monotonic, pts in self._snapshot(sel.family, sel.matchers):
+            v = _agg_window(pts, monotonic, agg, start, end)
+            if v is not None:
+                out.append((dict(key), v))
+        return out
+
+    @staticmethod
+    def _join_div(left: list[tuple[dict[str, str], float]],
+                  right: list[tuple[dict[str, str], float]]
+                  ) -> list[tuple[dict[str, str], float]]:
+        """Label-joined division: each left series pairs with the unique
+        right series agreeing on every shared label key; ambiguous or
+        zero-denominator pairs are dropped (an alert must never fire off
+        a nonsense join)."""
+        out = []
+        for llab, lv in left:
+            cands = []
+            for rlab, rv in right:
+                shared = set(llab) & set(rlab)
+                if all(llab[k] == rlab[k] for k in shared):
+                    cands.append(rv)
+            if len(cands) == 1 and cands[0] != 0:
+                out.append((llab, lv / cands[0]))
+        return out
+
+    def query(self, expr: str, window_s: float, agg: str,
+              at: float | None = None
+              ) -> list[tuple[dict[str, str], float]]:
+        """One aggregated value per matching series over the trailing
+        window. Ratio expressions aggregate both sides with the same
+        ``agg`` and join on shared labels."""
+        if at is None:
+            at = self._clock()
+        window_s = parse_window(window_s)
+        left, right = parse_expr(expr)
+        lres = self._eval(left, agg, at - window_s, at)
+        if right is None:
+            return lres
+        return self._join_div(lres, self._eval(right, agg, at - window_s, at))
+
+    def query_range(self, expr: str, window_s: float, step_s: float,
+                    agg: str, at: float | None = None
+                    ) -> list[tuple[dict[str, str], list[float | None]]]:
+        """Per-series value lists over ``window_s`` split into ``step_s``
+        buckets (the sparkline shape). Buckets with no samples are
+        ``None`` so a gap renders as a gap, not a zero."""
+        if at is None:
+            at = self._clock()
+        window_s = parse_window(window_s)
+        step_s = parse_window(step_s)
+        n = max(1, int(round(window_s / step_s)))
+        left, right = parse_expr(expr)
+
+        def eval_steps(sel: Selector) -> dict[_LabelItems,
+                                              list[float | None]]:
+            out: dict[_LabelItems, list[float | None]] = {}
+            for i in range(n):
+                start = at - window_s + i * step_s
+                for labels, v in self._eval(sel, agg, start,
+                                            start + step_s):
+                    key = tuple(sorted(labels.items()))
+                    out.setdefault(key, [None] * n)[i] = v
+            return out
+
+        lres = eval_steps(left)
+        if right is None:
+            return [(dict(k), vals) for k, vals in sorted(lres.items())]
+        rres = eval_steps(right)
+        out: list[tuple[dict[str, str], list[float | None]]] = []
+        for k, lvals in sorted(lres.items()):
+            llab = dict(k)
+            cands = []
+            for rk, rvals in rres.items():
+                rlab = dict(rk)
+                shared = set(llab) & set(rlab)
+                if all(llab[x] == rlab[x] for x in shared):
+                    cands.append(rvals)
+            if len(cands) != 1:
+                continue
+            out.append((llab, [
+                (lv / rv) if (lv is not None and rv not in (None, 0))
+                else None
+                for lv, rv in zip(lvals, cands[0])]))
+        return out
+
+
+def sparkline(values: Iterable[float | None], width: int | None = None
+              ) -> str:
+    """Unicode block sparkline; ``None`` gaps render as spaces. Scaled to
+    the series' own max (sparklines show shape, not magnitude — the table
+    column next to it shows the number)."""
+    blocks = "▁▂▃▄▅▆▇█"
+    vals = list(values)
+    if width is not None:
+        vals = vals[-width:]
+    present = [v for v in vals if v is not None]
+    if not present:
+        return " " * len(vals)
+    top = max(present)
+    lo = min(present)
+    span = top - lo
+    out = []
+    for v in vals:
+        if v is None:
+            out.append(" ")
+        elif span <= 0:
+            out.append(blocks[0] if top <= 0 else blocks[3])
+        else:
+            out.append(blocks[min(len(blocks) - 1,
+                                  int((v - lo) / span * (len(blocks) - 1)
+                                      + 0.5))])
+    return "".join(out)

@@ -3,8 +3,8 @@
 Verbs: init, daemon (serve/start/stop/kill/restart/status/logs/metrics),
 apply,
 create, delete, get, run, start, stop, kill, attach, log, purge, refresh,
-rollout, status, top, trace, doctor, image, build, team, uninstall,
-version, autocomplete.
+rollout, status, top, trace, query, alerts, doctor, image, build, team,
+uninstall, version, autocomplete.
 
 Workload verbs route to the daemon; read/maintenance verbs "promote" to an
 in-process controller when --no-daemon / KUKEON_NO_DAEMON is set (reference
@@ -652,39 +652,32 @@ def _fmt_ms(s) -> str:
     return "-" if s is None else f"{s * 1000:.0f}ms"
 
 
-def cmd_top(args):
-    """One-screen fleet view from a single federated scrape: the daemon
-    pulls every running model cell's /metrics (ScrapeCells) and this
-    renders the per-cell table — ready, QPS, TTFT p50/p95, queue depth,
-    HBM, restarts. Unreachable cells show their scrape error instead of
-    silently vanishing."""
-    try:
-        out = _client(args).call("ScrapeCells")
-    except KukeonError as e:
-        print(f"daemon unreachable: {e}", file=sys.stderr)
-        return 1
-    rows = out.get("cells", [])
-    if args.json:
-        _print(rows, True)
-        return 0
+def render_top(rows, sparks=None) -> str:
+    """The `kuke top` table as a string (pure so tests and the --watch
+    repaint share it). ``sparks`` is {cell: {qps/p95/queue: [values]}}
+    from the TSDB's range queries; when present each cell row grows a
+    history line of sparklines drawn from the daemon's own scrape
+    history rather than a single instantaneous scrape."""
+    from kukeon_tpu.obs.tsdb import sparkline
+
     if not rows:
-        print("no running model cells")
-        return 0
+        return "no running model cells"
+    lines = []
     fmt = "{:<32} {:<8} {:<6} {:>7} {:>8} {:>8} {:>6} {:>14} {:>9}"
-    print(fmt.format("CELL", "MODEL", "READY", "QPS", "P50TTFT", "P95TTFT",
-                     "QUEUE", "HBM", "RESTARTS"))
+    lines.append(fmt.format("CELL", "MODEL", "READY", "QPS", "P50TTFT",
+                            "P95TTFT", "QUEUE", "HBM", "RESTARTS"))
     for r in rows:
         if not r.get("ok"):
-            print(fmt.format(r["cell"], "-", "down", "-", "-", "-", "-",
-                             "-", r.get("restarts", 0))
-                  + f"  ({r.get('error', 'scrape failed')})")
+            lines.append(fmt.format(r["cell"], "-", "down", "-", "-", "-",
+                                    "-", "-", r.get("restarts", 0))
+                         + f"  ({r.get('error', 'scrape failed')})")
             continue
         if r.get("kind") == "gateway":
             # Gateway row: the replicated cell's front door. READY is the
             # replica census, QPS the aggregate over replicas; latency/HBM
             # live on the per-replica rows beneath it.
             ready = (f"{r.get('readyReplicas', 0)}/{r.get('replicas', '?')}")
-            print(fmt.format(
+            lines.append(fmt.format(
                 r["cell"], r.get("model") or "-", ready,
                 f"{r['qps']:.1f}" if r.get("qps") is not None else "-",
                 "-", "-", "-", "-", r.get("restarts", 0))
@@ -698,13 +691,168 @@ def cmd_top(args):
         # directly to a reconstructable trace (`kuke trace <id>`).
         exemplar = (f"  (p95 trace={r['ttftP95TraceId']})"
                     if r.get("ttftP95TraceId") else "")
-        print(fmt.format(
+        lines.append(fmt.format(
             r["cell"], r.get("model") or "-",
             "yes" if r.get("ready") else "no",
             f"{r['qps']:.1f}" if r.get("qps") is not None else "-",
             _fmt_ms(r.get("ttftP50S")), _fmt_ms(r.get("ttftP95S")),
             r.get("queueDepth", "-"), hbm, r.get("restarts", 0))
             + exemplar)
+        sp = (sparks or {}).get(r["cell"])
+        if sp:
+            lines.append("  {:<30} qps {:<12} p95 {:<12} queue {:<12}".format(
+                "history:", sparkline(sp.get("qps", ()), 10),
+                sparkline(sp.get("p95", ()), 10),
+                sparkline(sp.get("queue", ()), 10)).rstrip())
+    return "\n".join(lines)
+
+
+def _top_sparklines(c) -> dict:
+    """Three range queries against the daemon's TSDB -> per-cell value
+    lists for the --watch history columns (QPS summed over outcome
+    series). A daemon without history yet (or an old one without the
+    Query RPC) simply yields no sparklines."""
+    out: dict[str, dict[str, list]] = {}
+    specs = (("qps", "kukeon_engine_requests_total", "rate"),
+             ("p95", "kukeon_engine_ttft_seconds", "p95"),
+             ("queue", "kukeon_engine_queue_depth", "avg"))
+    for col, family, agg in specs:
+        try:
+            res = c.call("Query", expr=family, windowS="5m", agg=agg,
+                         stepS="30s")
+        except KukeonError:
+            continue
+        for row in res.get("range", []):
+            cell = row["labels"].get("cell")
+            if not cell:
+                continue
+            vals = row["values"]
+            slot = out.setdefault(cell, {})
+            prev = slot.get(col)
+            if prev is None:
+                slot[col] = list(vals)
+            else:
+                # requests_total carries an outcome label: sum the
+                # per-outcome rate series into one QPS line.
+                slot[col] = [
+                    None if (a is None and b is None)
+                    else (a or 0) + (b or 0)
+                    for a, b in zip(prev, vals)]
+    return out
+
+
+def cmd_top(args):
+    """One-screen fleet view from a single federated scrape: the daemon
+    pulls every running model cell's /metrics (ScrapeCells) and this
+    renders the per-cell table — ready, QPS, TTFT p50/p95, queue depth,
+    HBM, restarts. Unreachable cells show their scrape error instead of
+    silently vanishing. ``--watch`` repaints in place and adds per-cell
+    sparkline history (QPS, TTFT p95, queue depth) from the daemon's
+    in-memory scrape history instead of a single scrape."""
+    watch = getattr(args, "watch", False)
+    interval = getattr(args, "interval", None) or 5.0
+    c = _client(args)
+    try:
+        while True:
+            try:
+                out = c.call("ScrapeCells")
+            except KukeonError as e:
+                print(f"daemon unreachable: {e}", file=sys.stderr)
+                return 1
+            rows = out.get("cells", [])
+            if args.json:
+                _print(rows, True)
+                return 0
+            sparks = _top_sparklines(c) if (watch and rows) else None
+            body = render_top(rows, sparks)
+            if watch:
+                sys.stdout.write("\x1b[H\x1b[2J")
+                print(time.strftime("%H:%M:%S")
+                      + f" — kuke top (every {interval:g}s, history = last"
+                        " 5m; ctrl-c to exit)")
+            print(body)
+            if not watch:
+                return 0
+            sys.stdout.flush()
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _fmt_label_set(labels: dict) -> str:
+    if not labels:
+        return "(no labels)"
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+
+
+def cmd_query(args):
+    """Windowed query against the daemon's in-memory scrape history
+    (obs/tsdb.py): `kuke query 'kukeon_engine_ttft_seconds{cell=...}'
+    --window 5m --agg p95`. One row per matching series; --step adds a
+    sparkline of per-step values over the window."""
+    out = _client(args).call("Query", expr=args.expr, windowS=args.window,
+                             agg=args.agg, stepS=args.step)
+    if args.json:
+        _print(out, True)
+        return 0
+    series = out.get("series", [])
+    if not series:
+        print(f"no data for {args.expr!r} over the last {args.window} "
+              "(series outside retention, or the daemon has no history "
+              "yet)")
+        return 1
+    from kukeon_tpu.obs.tsdb import sparkline
+    rng = {json.dumps(r["labels"], sort_keys=True): r["values"]
+           for r in out.get("range", [])}
+    width = max(len(_fmt_label_set(s["labels"])) for s in series)
+    width = max(width, len("SERIES"))
+    print(f"{'SERIES':<{width}}  {args.agg.upper():>12}"
+          + ("  TREND" if rng else ""))
+    for s in sorted(series, key=lambda s: _fmt_label_set(s["labels"])):
+        line = (f"{_fmt_label_set(s['labels']):<{width}}  "
+                f"{s['value']:>12.6g}")
+        vals = rng.get(json.dumps(s["labels"], sort_keys=True))
+        if vals:
+            line += "  " + sparkline(vals)
+        print(line)
+    return 0
+
+
+def cmd_alerts(args):
+    """The alert engine's live state (one row per rule, plus one per
+    active labelset) and its recent firing/resolved transitions — the
+    operator view of kukeon_alerts_firing."""
+    out = _client(args).call("Alerts",
+                             transitions=getattr(args, "transitions", 50))
+    if args.json:
+        _print(out, True)
+        return 0
+    if out.get("rulesError"):
+        print(f"warning: KUKEON_ALERT_RULES ignored: {out['rulesError']}",
+              file=sys.stderr)
+    fmt = "{:<24} {:<9} {:<8} {:>12} {:>8} {}"
+    print(fmt.format("ALERT", "SEVERITY", "STATE", "VALUE", "FOR",
+                     "LABELS"))
+    now = time.time()
+    for r in out.get("alerts", []):
+        state = r["state"]
+        value = f"{r['value']:.4g}" if r.get("value") is not None else "-"
+        dur = (f"{max(0.0, now - r['since']):.0f}s"
+               if state != "ok" and r.get("since") is not None else "-")
+        labels = (_fmt_label_set(r["labels"]) if r.get("labels") else "-")
+        print(fmt.format(r["alert"], r["severity"], state, value, dur,
+                         labels))
+    trs = out.get("transitions", [])
+    if trs:
+        print("\nrecent transitions:")
+        for tr in trs[-10:]:
+            ts = time.strftime("%H:%M:%S", time.localtime(tr["at"]))
+            extra = f" cell={tr['cell']}" if tr.get("cell") else ""
+            if tr.get("trace_id"):
+                extra += f" trace={tr['trace_id']}"
+            print(f"  {ts} {tr['alert']} -> {tr['state']} "
+                  f"(value {tr['value']:.4g} vs {tr['threshold']:.4g})"
+                  f"{extra}")
     return 0
 
 
@@ -911,7 +1059,8 @@ _BASH_COMPLETION = """\
 _kuke_complete() {
     local cur="${COMP_WORDS[COMP_CWORD]}" prev="${COMP_WORDS[COMP_CWORD-1]}"
     local verbs="init apply create build daemon get delete doctor start status \
-stop team kill purge refresh rollout run attach log top trace autocomplete image uninstall version"
+stop team kill purge refresh rollout run attach log top trace query alerts \
+autocomplete image uninstall version"
     if [ "$COMP_CWORD" -eq 1 ]; then
         COMPREPLY=($(compgen -W "$verbs" -- "$cur")); return
     fi
@@ -1072,9 +1221,35 @@ def build_parser() -> argparse.ArgumentParser:
     _scope_args(sp)
 
     sub_add("status")
-    sub_add("top")
+    sp = sub_add("top")
+    sp.add_argument("-w", "--watch", action="store_true",
+                    help="repaint in place with sparkline history columns "
+                         "(QPS, TTFT p95, queue) from the daemon's scrape "
+                         "history")
+    sp.add_argument("--interval", type=float, default=5.0,
+                    help="--watch repaint interval in seconds")
     sub_add("doctor")
     sub_add("refresh")
+
+    sp = sub_add("query")
+    sp.add_argument("expr",
+                    help="family{label=value,...} with an optional "
+                         "'/ family{...}' ratio, e.g. "
+                         "'kukeon_engine_ttft_seconds{cell=default/"
+                         "default/default/llm}'")
+    sp.add_argument("--window", default="5m",
+                    help="trailing window (30s, 5m, 1h; default 5m)")
+    sp.add_argument("--agg", default="avg",
+                    choices=["rate", "delta", "avg", "max", "min",
+                             "latest", "p50", "p95", "p99"],
+                    help="aggregation over the window (p* need a "
+                         "histogram family)")
+    sp.add_argument("--step", default=None,
+                    help="also print a per-step sparkline (e.g. 30s)")
+
+    sp = sub_add("alerts")
+    sp.add_argument("-n", "--transitions", type=int, default=50,
+                    help="recent transitions to fetch")
 
     sp = sub_add("trace")
     sp.add_argument("trace_id",
@@ -1155,6 +1330,8 @@ HANDLERS = {
     "log": cmd_log,
     "status": cmd_status,
     "top": cmd_top,
+    "query": cmd_query,
+    "alerts": cmd_alerts,
     "trace": cmd_trace,
     "rollout": cmd_rollout,
     "doctor": cmd_doctor,

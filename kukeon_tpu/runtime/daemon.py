@@ -24,6 +24,7 @@ import traceback
 from kukeon_tpu import sanitize
 from kukeon_tpu.obs import federate as fed
 from kukeon_tpu.obs import percentile_from_counts
+from kukeon_tpu.obs.tsdb import parse_window as tsdb_parse_window
 from kukeon_tpu.runtime import consts
 from kukeon_tpu.runtime.api import types as t
 from kukeon_tpu.runtime.apply import parser
@@ -47,6 +48,11 @@ PROTOCOL_VERSION = "v1"
 # cell must cost the federated scrape at most this long, never block it.
 SCRAPE_TIMEOUT_ENV = "KUKEON_SCRAPE_TIMEOUT_S"
 DEFAULT_SCRAPE_TIMEOUT_S = 2.0
+
+# Background telemetry-loop cadence: every tick scrapes the fleet into the
+# in-daemon TSDB (obs/tsdb.py) and evaluates the alert rules.
+SCRAPE_INTERVAL_ENV = "KUKEON_SCRAPE_INTERVAL_S"
+DEFAULT_SCRAPE_INTERVAL_S = 10.0
 
 
 def model_cell_endpoints(ctl) -> list[tuple[str, str, dict]]:
@@ -170,6 +176,115 @@ def fetch_traces(endpoints: list[tuple[str, str, dict]],
     out = [s for part in results for s in part]
     out.sort(key=lambda s: s.get("startedAt") or 0.0)
     return out
+
+
+def _scrape_ok_family(scrapes: list[dict]) -> "fed.Family":
+    """The per-cell scrape verdict as a synthetic family — both the
+    federated Metrics exposition and the telemetry loop's TSDB ingest
+    carry it, so `kuke query kukeon_cell_scrape_ok` and the CellScrapeDown
+    alert read the same signal the operator sees."""
+    return fed.Family(
+        "kukeon_cell_scrape_ok", "gauge",
+        "1 when this pass scraped the cell's /metrics; 0 marks a "
+        "stale/unreachable cell.",
+        [("kukeon_cell_scrape_ok", {"cell": s["cell"]},
+          "1" if s["ok"] else "0") for s in scrapes])
+
+
+class FleetTelemetry:
+    """The daemon's telemetry backbone: a scrape tick pulls every cell's
+    /metrics (the PR-4 parse/relabel path), records scrape health, ingests
+    everything — the daemon's own registry included — into the in-daemon
+    TSDB, and evaluates the alert rules.
+
+    Separated from the server so tests drive :meth:`tick` synchronously
+    with an injectable clock; :class:`DaemonServer` runs it on a
+    background thread every ``KUKEON_SCRAPE_INTERVAL_S`` (default 10s).
+    Scrapes and TSDB row-building happen outside every lock (kukesan-clean
+    under ``KUKEON_SANITIZE=1``: snapshot outside, swap under lock)."""
+
+    def __init__(self, ctl, registry=None, clock=time.time,
+                 tsdb=None, rules=None):
+        from kukeon_tpu.obs import alerts as alerts_mod
+        from kukeon_tpu.obs import tsdb as tsdb_mod
+
+        self.ctl = ctl
+        self._clock = clock
+        self._reg = registry if registry is not None else ctl.runner.registry
+        self.tsdb = tsdb if tsdb is not None else tsdb_mod.TSDB(clock=clock)
+        self.user_rules_error: str | None = None
+        if rules is None:
+            rules = alerts_mod.BUILTIN_RULES
+            try:
+                rules += alerts_mod.load_user_rules()
+            except ValueError as e:
+                # A typo'd user rule file must not take the daemon down —
+                # but the error must stay visible: logged here, surfaced
+                # by the Alerts RPC / `kuke alerts` until fixed.
+                self.user_rules_error = str(e)
+                import logging
+                logging.getLogger("kukeon.alerts").error(
+                    "ignoring %s: %s", alerts_mod.RULES_ENV, e)
+        self.alerts = alerts_mod.AlertEngine(
+            self.tsdb, rules=rules, registry=self._reg, clock=clock)
+        self._m_scrape_dur = self._reg.histogram(
+            "kukeon_daemon_scrape_duration_seconds",
+            "Per-cell /metrics scrape wall time in the telemetry loop.",
+            labels=("cell",))
+        self._m_ticks = self._reg.counter(
+            "kukeon_daemon_scrape_ticks_total",
+            "Telemetry-loop scrape ticks completed.")
+        self._m_consec_fail = self._reg.gauge(
+            "kukeon_daemon_scrape_failures_consecutive",
+            "Consecutive failed scrapes per cell (0 on success): a "
+            "flapping cell oscillates, a dead one climbs.",
+            labels=("cell",))
+        # Only the telemetry tick mutates this (one loop thread); reads
+        # happen through the gauge snapshot.
+        self._consec_fail: dict[str, int] = {}
+        self._reg.gauge(
+            "kukeon_tsdb_series",
+            "Time series currently resident in the in-daemon store."
+        ).set_function(lambda: self.tsdb.stats()["series"])
+        self._reg.gauge(
+            "kukeon_tsdb_points",
+            "Total samples currently resident in the in-daemon store."
+        ).set_function(lambda: self.tsdb.stats()["points"])
+        self._reg.gauge(
+            "kukeon_tsdb_dropped_series",
+            "New series refused because the store hit "
+            "KUKEON_TSDB_MAX_SERIES."
+        ).set_function(lambda: self.tsdb.stats()["droppedSeries"])
+
+    def tick(self, at: float | None = None) -> list[dict]:
+        """One telemetry pass; returns the alert transitions it caused."""
+        from kukeon_tpu.obs import expo
+
+        now = self._clock() if at is None else at
+        scrapes = scrape_fleet(self.ctl)
+        seen = set()
+        for s in scrapes:
+            self._m_scrape_dur.observe(s["elapsedS"], cell=s["cell"])
+            n = 0 if s["ok"] else self._consec_fail.get(s["cell"], 0) + 1
+            self._consec_fail[s["cell"]] = n
+            self._m_consec_fail.set(n, cell=s["cell"])
+            seen.add(s["cell"])
+        for cell in [c for c in self._consec_fail if c not in seen]:
+            # The cell left the fleet; keep its gauge from lying forever.
+            del self._consec_fail[cell]
+        parts: list[dict] = []
+        # Own registry AFTER the duration/failure updates above so this
+        # very tick's scrape health lands in the store it feeds.
+        parts.append(fed.parse(expo.render(self._reg)))
+        for s in scrapes:
+            if s["ok"]:
+                fed.inject_label(s["families"], cell=s["cell"])
+                parts.append(s["families"])
+        parts.append({"kukeon_cell_scrape_ok": _scrape_ok_family(scrapes)})
+        for p in parts:
+            self.tsdb.ingest(p, at=now)
+        self._m_ticks.inc()
+        return self.alerts.evaluate(at=now)
 
 
 def _sample_value(fams: dict, name: str, **match) -> float | None:
@@ -336,6 +451,10 @@ class RPCService:
         self._m_rpc = reg.counter(
             "kukeon_daemon_rpc_requests_total",
             "RPC calls by method and result.", labels=("method", "result"))
+        # The fleet telemetry backbone (scrape history + alerting). The
+        # RPC service owns the state so Query/Alerts work on any service
+        # instance; DaemonServer drives tick() on its background loop.
+        self.telemetry = FleetTelemetry(ctl)
 
     # Every public method is an RPC endpoint.
 
@@ -593,12 +712,7 @@ class RPCService:
                 fed.inject_label(s["families"], cell=s["cell"])
                 parts.append(s["families"])
         merged = fed.merge(parts)
-        merged["kukeon_cell_scrape_ok"] = fed.Family(
-            "kukeon_cell_scrape_ok", "gauge",
-            "1 when this pass scraped the cell's /metrics; 0 marks a "
-            "stale/unreachable cell.",
-            [("kukeon_cell_scrape_ok", {"cell": s["cell"]},
-              "1" if s["ok"] else "0") for s in scrapes])
+        merged["kukeon_cell_scrape_ok"] = _scrape_ok_family(scrapes)
         return {"contentType": expo.CONTENT_TYPE,
                 "text": fed.render(merged)}
 
@@ -640,6 +754,52 @@ class RPCService:
         spans = fetch_traces(model_cell_endpoints(self.ctl),
                              trace_id=traceId, n=n, timeout_s=timeoutS)
         return {"spans": spans}
+
+    def Query(self, expr: str, windowS: float = 300.0, agg: str = "avg",
+              stepS: float | None = None) -> dict:
+        """Windowed query over the in-daemon TSDB: one aggregated value
+        per matching series (``kuke query``), plus per-step value lists
+        when ``stepS`` is given (the `kuke top --watch` sparkline shape).
+        The store only holds what the telemetry loop has scraped — an
+        empty result on a fresh daemon means "no history yet", not "no
+        such metric"."""
+        t = self.telemetry
+        try:
+            series = t.tsdb.query(expr, windowS, agg)
+            out = {
+                "expr": expr, "agg": agg,
+                "windowS": float(tsdb_parse_window(windowS)),
+                "retentionS": t.tsdb.retention_s,
+                "series": [{"labels": labels, "value": value}
+                           for labels, value in series],
+            }
+            if stepS is not None:
+                out["stepS"] = float(tsdb_parse_window(stepS))
+                out["range"] = [
+                    {"labels": labels, "values": values}
+                    for labels, values in t.tsdb.query_range(
+                        expr, windowS, stepS, agg)
+                ]
+        except ValueError as e:
+            raise InvalidArgument(str(e)) from None
+        return out
+
+    def Alerts(self, transitions: int = 50) -> dict:
+        """The alert engine's current state machines (one row per rule,
+        plus one per active labelset) and the recent transition ring —
+        what `kuke alerts` renders."""
+        t = self.telemetry
+        out = {"alerts": t.alerts.states(),
+               "transitions": t.alerts.transitions(transitions)}
+        if t.user_rules_error:
+            out["rulesError"] = t.user_rules_error
+        return out
+
+    def TelemetryTick(self) -> dict:
+        """Force one synchronous telemetry pass (scrape -> ingest ->
+        alert evaluation) outside the timer — the e2e tests' and an
+        operator's "scrape now" button."""
+        return {"transitions": self.telemetry.tick()}
 
     def RolloutCell(self, realm: str, space: str, stack: str, name: str,
                     drainTimeoutS: float = 60.0,
@@ -840,6 +1000,11 @@ class DaemonServer:
         ticker = threading.Thread(target=self._reconcile_loop, daemon=True,
                                   name="reconcile")
         ticker.start()
+        telemetry = threading.Thread(
+            target=self._telemetry_loop,
+            args=(self._server.rpc_service.telemetry,),  # type: ignore[attr-defined]
+            daemon=True, name="telemetry")
+        telemetry.start()
 
         def _stop(signum, frame):
             del signum, frame
@@ -880,6 +1045,18 @@ class DaemonServer:
                 m_ticks.inc()
                 for outcome, n in counts.items():
                     m_outcomes.inc(n, outcome=outcome)
+            except Exception:  # noqa: BLE001 — ticker must survive
+                traceback.print_exc()
+
+    def _telemetry_loop(self, telemetry: FleetTelemetry) -> None:
+        """The scrape ticker: every KUKEON_SCRAPE_INTERVAL_S, pull the
+        fleet's /metrics into the TSDB and evaluate the alert rules. The
+        loop must survive anything a cell throws at it."""
+        interval = float(os.environ.get(SCRAPE_INTERVAL_ENV, "")
+                         or DEFAULT_SCRAPE_INTERVAL_S)
+        while not self._shutdown.wait(interval):
+            try:
+                telemetry.tick()
             except Exception:  # noqa: BLE001 — ticker must survive
                 traceback.print_exc()
 

@@ -30,7 +30,7 @@ import time
 # /v1/trace span it belongs to share it, so logs and traces join on one
 # key across gateway, replicas, and engines.
 _EXTRA_FIELDS = ("request_id", "trace_id", "cell", "phase", "point",
-                 "outcome")
+                 "outcome", "alert", "severity")
 
 _LEVELS = {
     "debug": logging.DEBUG,

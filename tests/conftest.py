@@ -97,6 +97,17 @@ def pytest_sessionfinish(session, exitstatus):
 
 
 @pytest.fixture(autouse=True)
+def _isolate_telemetry_env(monkeypatch):
+    """The RPC service constructs a FleetTelemetry (TSDB + alert engine)
+    on every instantiation; stray alert-rule / retention env from one test
+    must never rewire another test's daemon."""
+    for var in ("KUKEON_ALERT_RULES", "KUKEON_ALERT_WEBHOOK",
+                "KUKEON_SCRAPE_INTERVAL_S", "KUKEON_TSDB_RETENTION_S",
+                "KUKEON_TSDB_MAX_SERIES"):
+        monkeypatch.delenv(var, raising=False)
+
+
+@pytest.fixture(autouse=True)
 def _isolate_faults():
     """Guarantee KUKEON_FAULTS never leaks between tests: an armed fault
     spec surviving one test would fire random failures in the next. Cleared

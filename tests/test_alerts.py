@@ -1,0 +1,578 @@
+"""The alerting engine (obs/alerts.py) and the daemon telemetry loop
+(FleetTelemetry): rule validation, the pending->firing->resolved state
+machine (pending never fires early; resolved clears), per-cell scrape
+health, webhook/exemplar decoration, the Query/Alerts RPCs + CLI, and the
+acceptance spine — a fake-backend fleet scraped for 30+ ticks whose
+deadline storm flips the SLO-burn alert within two scrape intervals and
+resolves after the storm."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kukeon_tpu import obs
+from kukeon_tpu.obs import Registry, SloTracker, expo
+from kukeon_tpu.obs import alerts as alerts_mod
+from kukeon_tpu.obs import federate as fed
+from kukeon_tpu.obs.alerts import (
+    BUILTIN_RULES,
+    AlertEngine,
+    Rule,
+    load_user_rules,
+    validate_rule,
+)
+from kukeon_tpu.obs.tsdb import TSDB, parse_expr
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.cells import FakeBackend
+from kukeon_tpu.runtime.controller import Controller
+from kukeon_tpu.runtime.daemon import FleetTelemetry, RPCService
+from kukeon_tpu.runtime.devices import TPUDeviceManager
+from kukeon_tpu.runtime.errors import InvalidArgument
+from kukeon_tpu.runtime.metadata import MetadataStore
+from kukeon_tpu.runtime.runner import Runner, RunnerOptions
+from kukeon_tpu.runtime.store import ResourceStore
+
+from test_federation import _free_port
+from test_obs import _parse_expo
+
+
+def _fam(name: str, kind: str, *samples) -> dict:
+    return {name: fed.Family(name, kind, "", [
+        (name, dict(labels), str(value)) for labels, value in samples])}
+
+
+# --- rule validation ---------------------------------------------------------
+
+
+def test_validate_rule_names_every_problem():
+    ok = {"name": "r", "expr": "kukeon_g", "agg": "max", "window": "1m",
+          "op": ">", "threshold": 5}
+    r = validate_rule(ok)
+    assert r.window_s == 60.0 and r.threshold == 5.0 and r.for_s == 0.0
+    cases = (
+        ({**ok, "agg": "median"}, "agg"),
+        ({**ok, "op": ">="}, "op"),
+        ({**ok, "severity": "sev1"}, "severity"),
+        ({**ok, "window": "nope"}, "window"),
+        ({**ok, "threshold": "high"}, "threshold"),
+        ({**ok, "expr": "a / b / c"}, "'/'"),
+        ({**ok, "bogus": 1}, "bogus"),
+        ({k: v for k, v in ok.items() if k != "expr"}, "expr"),
+        ("not a mapping", "mapping"),
+    )
+    for doc, needle in cases:
+        with pytest.raises(ValueError, match=needle):
+            validate_rule(doc)
+
+
+def test_load_user_rules_file_inline_and_yaml(tmp_path, monkeypatch):
+    doc = [{"name": "QueueDeep", "expr": "kukeon_engine_queue_depth",
+            "agg": "avg", "window": "2m", "op": ">", "threshold": 5,
+            "for": "30s", "severity": "info"}]
+    # Inline JSON via the env var.
+    monkeypatch.setenv(alerts_mod.RULES_ENV, json.dumps(doc))
+    (rule,) = load_user_rules()
+    assert rule.name == "QueueDeep" and rule.for_s == 30.0
+    # JSON file path.
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps(doc))
+    assert load_user_rules(str(p)) == (rule,)
+    # YAML file path.
+    y = tmp_path / "rules.yaml"
+    y.write_text("- name: QueueDeep\n  expr: kukeon_engine_queue_depth\n"
+                 "  agg: avg\n  window: 2m\n  op: '>'\n  threshold: 5\n"
+                 "  for: 30s\n  severity: info\n")
+    assert load_user_rules(str(y)) == (rule,)
+    # A single mapping is a list of one; unset/empty spec is no rules.
+    assert load_user_rules(json.dumps(doc[0])) == (rule,)
+    assert load_user_rules("") == ()
+    # Shadowing a built-in (or duplicating) is an error, as is garbage.
+    with pytest.raises(ValueError, match="duplicate"):
+        load_user_rules(json.dumps(doc + doc))
+    with pytest.raises(ValueError, match="duplicate"):
+        load_user_rules(json.dumps([{**doc[0], "name": "SloBurnFast"}]))
+    with pytest.raises(ValueError, match="cannot read"):
+        load_user_rules(str(tmp_path / "missing.json"))
+    with pytest.raises(ValueError, match="mapping"):
+        load_user_rules("[1, 2]")
+
+
+def test_builtin_rules_are_well_formed():
+    from kukeon_tpu.obs.tsdb import AGGS
+    names = set()
+    for r in BUILTIN_RULES:
+        assert r.name not in names
+        names.add(r.name)
+        assert r.agg in AGGS and r.op in alerts_mod.OPS
+        assert r.severity in alerts_mod.SEVERITIES
+        assert r.window_s > 0 and r.for_s >= 0
+        parse_expr(r.expr)                   # must be parseable
+    assert {"SloBurnFast", "SloBurnSlow", "ContainerRestartLoop",
+            "HbmPressure", "QueueSaturation", "CellScrapeDown",
+            "ColdStartRegression"} <= names
+
+
+# --- state machine -----------------------------------------------------------
+
+
+def _engine(rule, clock, registry=None, webhook=None):
+    db = TSDB(retention_s=3600, clock=clock)
+    eng = AlertEngine(db, rules=(rule,), registry=registry, clock=clock,
+                      webhook_url=webhook or "")
+    return db, eng
+
+
+def test_for_duration_pending_never_fires_early():
+    now = [0.0]
+    clock = lambda: now[0]
+    reg = Registry()
+    rule = Rule(name="G", expr="kukeon_g", agg="latest", window_s=60,
+                op=">", threshold=5, for_s=25, severity="critical")
+    db, eng = _engine(rule, clock, registry=reg)
+    firing = reg.get("kukeon_alerts_firing")
+    transitions = []
+    for at in (0, 10, 20):
+        now[0] = at
+        db.ingest(_fam("kukeon_g", "gauge", ({"cell": "a"}, 9)), at=at)
+        transitions += eng.evaluate(at=at)
+        # Breaching but inside for_s: pending, never firing.
+        assert transitions == []
+        (st,) = [s for s in eng.states() if s.get("labels")]
+        assert st["state"] == "pending" and st["since"] == 0
+        assert firing.value(alert="G", severity="critical") == 0
+    now[0] = 30
+    db.ingest(_fam("kukeon_g", "gauge", ({"cell": "a"}, 9)), at=30)
+    (tr,) = eng.evaluate(at=30)
+    assert tr["state"] == "firing" and tr["cell"] == "a"
+    assert firing.value(alert="G", severity="critical") == 1
+    # Breach clears -> resolved transition, state back to ok, gauge to 0.
+    now[0] = 40
+    db.ingest(_fam("kukeon_g", "gauge", ({"cell": "a"}, 1)), at=40)
+    (tr,) = eng.evaluate(at=40)
+    assert tr["state"] == "resolved"
+    assert [s["state"] for s in eng.states()] == ["ok"]
+    assert firing.value(alert="G", severity="critical") == 0
+    assert [t_["state"] for t_ in eng.transitions()] == [
+        "firing", "resolved"]
+
+
+def test_for_zero_fires_on_first_breaching_tick():
+    now = [0.0]
+    rule = Rule(name="G", expr="kukeon_g", agg="latest", window_s=60,
+                op=">", threshold=5, for_s=0)
+    db, eng = _engine(rule, lambda: now[0])
+    db.ingest(_fam("kukeon_g", "gauge", ({}, 9)), at=0)
+    (tr,) = eng.evaluate(at=0)
+    assert tr["state"] == "firing"
+
+
+def test_pending_that_clears_cancels_silently():
+    now = [0.0]
+    rule = Rule(name="G", expr="kukeon_g", agg="latest", window_s=60,
+                op=">", threshold=5, for_s=30)
+    db, eng = _engine(rule, lambda: now[0])
+    db.ingest(_fam("kukeon_g", "gauge", ({}, 9)), at=0)
+    assert eng.evaluate(at=0) == []
+    db.ingest(_fam("kukeon_g", "gauge", ({}, 1)), at=10)
+    assert eng.evaluate(at=10) == []
+    assert eng.transitions() == []          # the near-miss made no noise
+    assert [s["state"] for s in eng.states()] == ["ok"]
+
+
+def test_alerts_fire_per_labelset():
+    now = [0.0]
+    reg = Registry()
+    rule = Rule(name="G", expr="kukeon_g", agg="latest", window_s=60,
+                op=">", threshold=5, for_s=0, severity="warning")
+    db, eng = _engine(rule, lambda: now[0], registry=reg)
+    db.ingest(_fam("kukeon_g", "gauge",
+                   ({"cell": "a"}, 9), ({"cell": "b"}, 1),
+                   ({"cell": "c"}, 7)), at=0)
+    trs = eng.evaluate(at=0)
+    assert sorted(tr["cell"] for tr in trs) == ["a", "c"]
+    assert reg.get("kukeon_alerts_firing").value(
+        alert="G", severity="warning") == 2
+
+
+def test_transition_carries_exemplar_trace_id():
+    now = [0.0]
+    rule = Rule(name="G", expr="kukeon_slo_burn_rate", agg="latest",
+                window_s=60, op=">", threshold=5, for_s=0,
+                exemplar_family="kukeon_engine_ttft_seconds")
+    db, eng = _engine(rule, lambda: now[0])
+    reg = Registry()
+    h = reg.histogram("kukeon_engine_ttft_seconds", "t")
+    h.observe(1.5, exemplar="cd" * 16)
+    fams = fed.parse(expo.render(reg))
+    fed.inject_label(fams, cell="r/s/st/c")
+    db.ingest(fams, at=0)
+    db.ingest(_fam("kukeon_slo_burn_rate", "gauge",
+                   ({"cell": "r/s/st/c"}, 50.0)), at=0)
+    (tr,) = eng.evaluate(at=0)
+    assert tr["trace_id"] == "cd" * 16 and tr["cell"] == "r/s/st/c"
+
+
+def test_webhook_posts_transitions():
+    got: list[dict] = []
+
+    class Hook(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            got.append(json.loads(body))
+            self.send_response(200)
+            self.end_headers()
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Hook)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        now = [0.0]
+        reg = Registry()
+        rule = Rule(name="G", expr="kukeon_g", agg="latest", window_s=60,
+                    op=">", threshold=5, for_s=0)
+        db, eng = _engine(
+            rule, lambda: now[0], registry=reg,
+            webhook=f"http://127.0.0.1:{srv.server_address[1]}/hook")
+        db.ingest(_fam("kukeon_g", "gauge", ({"cell": "a"}, 9)), at=0)
+        eng.evaluate(at=0)
+        deadline = time.monotonic() + 5
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert got and got[0]["alert"] == "G"
+        assert got[0]["state"] == "firing" and got[0]["cell"] == "a"
+        deadline = time.monotonic() + 5
+        while (reg.get("kukeon_alerts_webhook_total").value(result="ok")
+               < 1 and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert reg.get("kukeon_alerts_webhook_total").value(
+            result="ok") == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# --- the fake-backend fleet --------------------------------------------------
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    registry: Registry = None  # type: ignore[assignment]
+
+    def log_message(self, fmt, *a):
+        pass
+
+    def do_GET(self):
+        if self.path != "/metrics":
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = expo.render(self.registry).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", expo.CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class _Fleet:
+    """Two live model cells (real /metrics HTTP endpoints backed by
+    registries with engine counters + a SloTracker) plus one dead port,
+    under a fake-backend controller, with one injectable clock shared by
+    the cells' SLO windows and the daemon's telemetry loop."""
+
+    def __init__(self, tmp_path, dead_cell=True):
+        self.now = 1_000_000.0
+        self.clock = lambda: self.now
+        store = ResourceStore(MetadataStore(str(tmp_path)))
+        runner = Runner(store, FakeBackend(), cgroups=None,
+                        devices=TPUDeviceManager(store.ms,
+                                                 chips=[0, 1, 2, 3]),
+                        options=RunnerOptions(stop_grace_s=0.2),
+                        registry=obs.Registry())
+        self.ctl = Controller(store, runner)
+        self.ctl.bootstrap()
+        self.servers = []
+        self.cells: dict[str, tuple] = {}
+        names = ["llm-a", "llm-b"] + (["llm-dead"] if dead_cell else [])
+        for name in names:
+            if name == "llm-dead":
+                port = _free_port()
+            else:
+                reg = Registry()
+                reg.gauge("kukeon_cell_ready", "r").set(1)
+                reg.gauge("kukeon_cell_uptime_seconds", "u").set_function(
+                    lambda: self.now - 999_000.0)
+                c = reg.counter("kukeon_engine_requests_total", "req",
+                                labels=("outcome",))
+                h = reg.histogram("kukeon_engine_ttft_seconds", "ttft")
+                reg.gauge("kukeon_engine_queue_depth", "q").set(1)
+                SloTracker(reg, clock=self.clock)
+                self.cells[name] = (c, h)
+                handler = type("H", (_MetricsHandler,),
+                               {"registry": reg})
+                srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+                threading.Thread(target=srv.serve_forever,
+                                 daemon=True).start()
+                self.servers.append(srv)
+                port = srv.server_address[1]
+            self.ctl.create_cell(t.Document(
+                kind=t.KIND_CELL, metadata=t.Metadata(name=name),
+                spec=t.CellSpec(model=t.ModelSpec(model="tiny", chips=1,
+                                                  port=port))))
+        self.svc = RPCService(self.ctl)
+        # Swap in a clock-driven telemetry backbone (the RPC service built
+        # one with the wall clock).
+        self.svc.telemetry = FleetTelemetry(self.ctl, clock=self.clock)
+
+    def tick(self, dt=10.0, ok=0, timeout=0, ttft=()):
+        """Advance time, apply traffic to both cells, run one telemetry
+        pass; returns the alert transitions it produced."""
+        self.now += dt
+        for c, h in self.cells.values():
+            if ok:
+                c.inc(ok, outcome="ok")
+            if timeout:
+                c.inc(timeout, outcome="timeout")
+            for v in ttft:
+                h.observe(v, exemplar="ab" * 16)
+        return self.svc.telemetry.tick()
+
+    def close(self):
+        for srv in self.servers:
+            srv.shutdown()
+            srv.server_close()
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    f = _Fleet(tmp_path)
+    yield f
+    f.close()
+
+
+def test_scrape_health_instruments_and_scrape_down_alert(fleet):
+    """Satellite: per-cell scrape-duration histogram + consecutive-failure
+    gauge distinguish flapping from dead, and the CellScrapeDown builtin
+    fires for the dead cell only after its for: duration."""
+    transitions = []
+    for _ in range(4):                       # t+10 .. t+40
+        transitions += fleet.tick(ok=2, ttft=(0.02,))
+    reg = fleet.ctl.runner.registry
+    dead = "default/default/default/llm-dead"
+    live = "default/default/default/llm-a"
+    assert reg.get("kukeon_daemon_scrape_failures_consecutive").value(
+        cell=dead) == 4
+    assert reg.get("kukeon_daemon_scrape_failures_consecutive").value(
+        cell=live) == 0
+    counts, _total, n = reg.get(
+        "kukeon_daemon_scrape_duration_seconds").snapshot(cell=live)
+    assert n == 4
+    assert reg.get("kukeon_daemon_scrape_ticks_total").value() == 4
+    # scrape_ok history is queryable like any other series.
+    vals = dict((labels["cell"], v) for labels, v in
+                fleet.svc.telemetry.tsdb.query(
+                    "kukeon_cell_scrape_ok", 60, "max", at=fleet.now))
+    assert vals[live] == 1.0 and vals[dead] == 0.0
+    # CellScrapeDown: pending from the first tick, firing once the breach
+    # held for 30s — and only for the dead cell.
+    fired = [tr for tr in transitions if tr["alert"] == "CellScrapeDown"
+             and tr["state"] == "firing"]
+    assert [tr["cell"] for tr in fired] == [dead]
+    # The dead cell leaving the fleet resolves its alert.
+    fleet.ctl.delete_cell("default", "default", "default", "llm-dead",
+                          True)
+    resolved = []
+    for _ in range(8):
+        resolved += [tr for tr in fleet.tick(ok=1)
+                     if tr["alert"] == "CellScrapeDown"]
+    assert [tr["state"] for tr in resolved] == ["resolved"]
+
+
+def test_user_rules_error_is_surfaced_not_fatal(fleet, monkeypatch):
+    monkeypatch.setenv(alerts_mod.RULES_ENV, '[{"name": "broken"}]')
+    telem = FleetTelemetry(fleet.ctl, clock=fleet.clock)
+    assert telem.user_rules_error and "broken" in telem.user_rules_error
+    assert telem.alerts.rules == BUILTIN_RULES   # builtins still armed
+    fleet.svc.telemetry = telem
+    out = fleet.svc.Alerts()
+    assert "broken" in out["rulesError"]
+
+
+def test_user_rule_rides_along_and_fires(fleet, monkeypatch):
+    monkeypatch.setenv(alerts_mod.RULES_ENV, json.dumps([{
+        "name": "QueueNonEmpty", "expr": "kukeon_engine_queue_depth",
+        "agg": "max", "window": "1m", "op": ">", "threshold": 0.5,
+        "severity": "info"}]))
+    fleet.svc.telemetry = FleetTelemetry(fleet.ctl, clock=fleet.clock)
+    trs = fleet.tick(ok=1)                    # queue depth is 1 on both
+    fired = [tr for tr in trs if tr["alert"] == "QueueNonEmpty"]
+    assert len(fired) == 2 and all(tr["severity"] == "info"
+                                   for tr in fired)
+
+
+def test_query_rpc_validates(fleet):
+    with pytest.raises(InvalidArgument):
+        fleet.svc.Query(expr="a / b / c")
+    with pytest.raises(InvalidArgument):
+        fleet.svc.Query(expr="kukeon_g", agg="median")
+    with pytest.raises(InvalidArgument):
+        fleet.svc.Query(expr="kukeon_g", windowS="sideways")
+
+
+# --- acceptance: history, storm, resolution ----------------------------------
+
+
+def test_acceptance_windowed_p95_and_slo_burn_storm(fleet, capsys,
+                                                    monkeypatch):
+    """The ISSUE 10 acceptance spine: the daemon scrapes 2 live cells for
+    30+ ticks; `kuke query --agg p95 --window 5m` matches each cell's own
+    histogram percentile within one bucket; a deadline storm flips
+    SloBurnFast to firing within 2 scrape intervals and it resolves after
+    the storm — both transitions visible in `kuke alerts` and in the
+    federated kukeon_alerts_firing series."""
+    ttft = (0.01, 0.03, 0.08)
+    for _ in range(32):                       # >= 30 ticks of history
+        fleet.tick(ok=3, ttft=ttft)
+    assert fleet.svc.telemetry.tsdb.stats()["ingests"] >= 32
+
+    out = fleet.svc.Query(expr="kukeon_engine_ttft_seconds",
+                          windowS="5m", agg="p95")
+    rows = {r["labels"]["cell"]: r["value"] for r in out["series"]}
+    h = fleet.cells["llm-a"][1]
+    exact = h.percentile(0.95)
+
+    def bucket_index(v):
+        return next((i for i, b in enumerate(h.buckets) if v <= b),
+                    len(h.buckets))
+
+    for name in ("llm-a", "llm-b"):
+        got = rows[f"default/default/default/{name}"]
+        assert abs(bucket_index(got) - bucket_index(exact)) <= 1
+
+    # Deadline storm: most requests start timing out. The cell's own
+    # 5m-window SloTracker burn spikes on the next scrape, and the
+    # SloBurnFast rule (for: 0) must fire within 2 scrape intervals.
+    storm_transitions = []
+    for i in range(2):
+        storm_transitions += [
+            (i, tr) for tr in fleet.tick(timeout=20, ttft=(2.5,))]
+    fired = [(i, tr) for i, tr in storm_transitions
+             if tr["alert"] == "SloBurnFast" and tr["state"] == "firing"]
+    assert len(fired) == 2                    # one per cell
+    assert all(i == 0 for i, _tr in fired)    # first post-storm tick
+    (tr0) = fired[0][1]
+    assert tr0["severity"] == "critical"
+    assert tr0["trace_id"] == "ab" * 16       # TTFT exemplar rides along
+
+    # Firing census is a real federated metric: the daemon Metrics RPC
+    # exposition carries kukeon_alerts_firing{alert="SloBurnFast"} 2.
+    fams = _parse_expo(fleet.svc.Metrics(federate=False)["text"])
+    firing = {lab["alert"]: float(v) for _n, lab, v
+              in fams["kukeon_alerts_firing"]["samples"]}
+    assert firing["SloBurnFast"] == 2
+
+    # Storm ends; healthy traffic resumes. The cell's 5m SLO window
+    # slides past the storm and the alert resolves.
+    resolutions = []
+    for _ in range(45):
+        resolutions += [tr for tr in fleet.tick(ok=5, ttft=(0.02,))
+                        if tr["alert"] == "SloBurnFast"]
+    assert [tr["state"] for tr in resolutions] == ["resolved", "resolved"]
+    fams = _parse_expo(fleet.svc.Metrics(federate=False)["text"])
+    firing = {lab["alert"]: float(v) for _n, lab, v
+              in fams["kukeon_alerts_firing"]["samples"]}
+    assert firing["SloBurnFast"] == 0
+
+    # Both transitions render in `kuke alerts`.
+    from kukeon_tpu.runtime import cli
+
+    class _Client:
+        def call(self, method, **params):
+            return getattr(fleet.svc, method)(**params)
+
+    monkeypatch.setattr(cli, "_client", lambda args: _Client())
+    assert cli.cmd_alerts(argparse.Namespace(json=False,
+                                             transitions=50)) == 0
+    rendered = capsys.readouterr().out
+    assert "SloBurnFast -> firing" in rendered
+    assert "SloBurnFast -> resolved" in rendered
+    assert "trace=" + "ab" * 16 in rendered
+    assert "ALERT" in rendered and "SEVERITY" in rendered
+
+
+def test_cmd_query_renders_table_and_sparkline(fleet, capsys, monkeypatch):
+    for _ in range(12):
+        fleet.tick(ok=4, ttft=(0.02, 0.05))
+    from kukeon_tpu.runtime import cli
+
+    class _Client:
+        def call(self, method, **params):
+            return getattr(fleet.svc, method)(**params)
+
+    monkeypatch.setattr(cli, "_client", lambda args: _Client())
+    args = argparse.Namespace(json=False,
+                              expr="kukeon_engine_requests_total{outcome=ok}",
+                              window="2m", agg="rate", step="30s")
+    assert cli.cmd_query(args) == 0
+    out = capsys.readouterr().out
+    assert "SERIES" in out and "RATE" in out and "TREND" in out
+    assert "cell=default/default/default/llm-a" in out
+    # A family with no history exits 1 with a hint, not a traceback.
+    args = argparse.Namespace(json=False, expr="kukeon_never_seen",
+                              window="2m", agg="avg", step=None)
+    assert cli.cmd_query(args) == 1
+    assert "no data" in capsys.readouterr().out
+    # JSON mode emits the raw RPC result.
+    args = argparse.Namespace(json=True, expr="kukeon_engine_queue_depth",
+                              window="2m", agg="latest", step=None)
+    assert cli.cmd_query(args) == 0
+    assert '"series"' in capsys.readouterr().out
+
+
+def test_kuke_top_watch_repaints_with_sparklines(fleet, capsys,
+                                                 monkeypatch):
+    for _ in range(12):
+        fleet.tick(ok=4, ttft=(0.02, 0.05))
+    from kukeon_tpu.runtime import cli
+
+    class _Client:
+        def call(self, method, **params):
+            return getattr(fleet.svc, method)(**params)
+
+    monkeypatch.setattr(cli, "_client", lambda args: _Client())
+    paints = []
+
+    def fake_sleep(_s):
+        paints.append(1)
+        if len(paints) >= 2:                 # two repaints, then exit
+            raise KeyboardInterrupt
+
+    monkeypatch.setattr(cli.time, "sleep", fake_sleep)
+    args = argparse.Namespace(json=False, watch=True, interval=0.01)
+    assert cli.cmd_top(args) == 0
+    out = capsys.readouterr().out
+    assert "\x1b[H\x1b[2J" in out            # in-place repaint
+    assert out.count("CELL") >= 2            # the table painted twice
+    assert "history:" in out and "qps" in out and "queue" in out
+    # Non-watch mode is unchanged: single table, no history rows.
+    monkeypatch.setattr(cli.time, "sleep",
+                        lambda s: (_ for _ in ()).throw(AssertionError))
+    args = argparse.Namespace(json=False)
+    assert cli.cmd_top(args) == 0
+    out = capsys.readouterr().out
+    assert "CELL" in out and "history:" not in out
+
+
+def test_telemetry_tick_rpc(fleet):
+    out = fleet.svc.TelemetryTick()
+    assert out == {"transitions": []}
+    assert fleet.ctl.runner.registry.get(
+        "kukeon_daemon_scrape_ticks_total").value() == 1
+    assert fleet.svc.telemetry.tsdb.stats()["series"] > 0

@@ -539,6 +539,66 @@ def test_kuke010_silent_without_a_trace_module(tmp_path):
     assert run_analysis(pkg, select=["KUKE010"]) == []
 
 
+# --- KUKE011: alert rules vs the metric registry -----------------------------
+
+
+ALERTS_FIXTURE = '''
+    BUILTIN_RULES = (
+        Rule(name="Good", expr="kukeon_known_total", agg="max",
+             window_s=60, op=">", threshold=1),
+        Rule(name="Dead", expr="kukeon_missing_total", agg="max",
+             window_s=60, op=">", threshold=1),
+        Rule(name="Dyn", expr=_BUILT_AT_IMPORT, agg="max",
+             window_s=60, op=">", threshold=1),
+        Rule(name="Ratio",
+             expr="kukeon_known_total{cell=x} / kukeon_also_missing",
+             agg="max", window_s=60, op=">", threshold=1),
+    )
+'''
+
+
+def test_kuke011_flags_undeclared_and_dynamic_rule_families(tmp_path):
+    pkg = _mini_repo(tmp_path, {
+        "obs/alerts.py": ALERTS_FIXTURE,
+        # The declared registry lives OUTSIDE the alerts module — a
+        # rule's own expr literal must never satisfy itself ("Dead"
+        # references kukeon_missing_total as a plain literal and is
+        # still a finding).
+        "serving/metrics.py": 'FAMS = ("kukeon_known_total",)\n',
+    })
+    found = run_analysis(pkg, select=["KUKE011"])
+    assert sorted(f.detail for f in found) == [
+        "<dynamic>", "kukeon_also_missing", "kukeon_missing_total"]
+    by_detail = {f.detail: f for f in found}
+    assert by_detail["kukeon_missing_total"].scope == "Dead"
+    assert by_detail["<dynamic>"].scope == "Dyn"
+    assert by_detail["kukeon_also_missing"].scope == "Ratio"
+    assert all(f.file.endswith("obs/alerts.py") for f in found)
+
+
+def test_kuke011_silent_when_families_are_declared(tmp_path):
+    pkg = _mini_repo(tmp_path, {
+        "obs/alerts.py": '''
+            BUILTIN_RULES = (
+                Rule(name="A", expr="kukeon_a_total{cell=x}", agg="max",
+                     window_s=60, op=">", threshold=1),
+                Rule(name="B", expr="kukeon_a_total / kukeon_b", agg="avg",
+                     window_s=60, op="<", threshold=1),
+            )
+        ''',
+        "serving/metrics.py":
+            'FAMS = ("kukeon_a_total", "kukeon_b")\n',
+    })
+    assert run_analysis(pkg, select=["KUKE011"]) == []
+
+
+def test_kuke011_silent_without_an_alerts_module(tmp_path):
+    pkg = _mini_repo(tmp_path, {
+        "mod.py": 'FAMS = ("kukeon_a_total",)\n',
+    })
+    assert run_analysis(pkg, select=["KUKE011"]) == []
+
+
 # --- baseline suppression ----------------------------------------------------
 
 
@@ -619,7 +679,7 @@ def test_all_rules_are_registered():
     assert registered_rules() == (
         "KUKE001", "KUKE002", "KUKE003", "KUKE004",
         "KUKE005", "KUKE006", "KUKE007", "KUKE008", "KUKE009",
-        "KUKE010",
+        "KUKE010", "KUKE011",
     )
 
 
