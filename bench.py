@@ -433,6 +433,221 @@ def phase_mixed(args) -> None:
     print(json.dumps(line), flush=True)
 
 
+def phase_disagg(args) -> None:
+    """Disaggregated prefill/decode serving vs mixed co-location at equal
+    chips and equal KV HBM (`bench.py --mixed --disagg`): the bimodal
+    agent-session flood runs twice through the REAL gateway + HTTP path —
+    once against two ``mixed`` replicas, once against a 1-prefill +
+    1-decode split with the page-granular KV handoff between them. Both
+    arms use identical cells (same slots, same page pool) so the only
+    variable is the architecture.
+
+    TTFT is measured CLIENT-side: wall time from POST to the first ndjson
+    line of a streaming request — the exact latency the TTFT-p95 SLO
+    tracker pages on. The disaggregated arm's first token goes out after
+    prefill+transfer, before the request waits for a decode slot; the
+    mixed arm's waits for slot seating behind co-located decode — that
+    architectural difference is what this phase quantifies. The handoff
+    cost itself rides along from the gateway's own
+    ``kukeon_handoff_seconds`` histogram."""
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from kukeon_tpu.gateway.cell import GatewayCell, make_gateway_handler
+    from kukeon_tpu.runtime.serving_cell import ServingCell, make_handler
+
+    backend = jax.default_backend()
+    n_chips = len(jax.devices())
+    # Tiny-model scale on every backend: the layer under test is the
+    # serving architecture (routing, handoff, slot queueing), not the
+    # matmuls — same rationale as the gateway phase.
+    num_slots = 2
+    max_seq = 128
+    pt = args.kv_page_tokens or 16
+    prefix_len, chat_tail, long_tail = 48, 8, 32
+    chat_gen, long_gen, n_sessions = 12, 40, 16
+
+    rng = np.random.default_rng(7)
+    prefix = [int(x) for x in rng.integers(1, 250, size=prefix_len)]
+    workload = []            # (promptTokens, max_new_tokens)
+    for i in range(n_sessions):
+        is_long = i % 2 == 1   # bimodal: half long agent turns, half chatty
+        tail = [int(x) for x in rng.integers(
+            1, 250, size=long_tail if is_long else chat_tail)]
+        workload.append((prefix + tail,
+                         long_gen if is_long else chat_gen))
+
+    def run_arm(roles: tuple) -> dict:
+        import http.client
+
+        cells, servers, urls = [], [], []
+        for role in roles:
+            cell = ServingCell(
+                "tiny", num_slots=num_slots, max_seq_len=max_seq,
+                checkpoint=None, dtype=None, kv_page_tokens=pt,
+                max_pending=512, role=role)
+            cell.engine.start()
+            cell.mark_ready()
+            srv = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(cell))
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            cells.append(cell)
+            servers.append(srv)
+            urls.append(f"http://127.0.0.1:{srv.server_address[1]}")
+        gw = GatewayCell("tiny", urls, poll_interval_s=0.1)
+        gw.start()
+        gw.router.poll_once()
+        gw_srv = ThreadingHTTPServer(("127.0.0.1", 0),
+                                     make_gateway_handler(gw))
+        threading.Thread(target=gw_srv.serve_forever, daemon=True).start()
+        port = gw_srv.server_address[1]
+
+        def post_stream(body: dict):
+            """(ttft_s, n_tokens, status, saw_error) for one streaming
+            request — TTFT stops at the FIRST ndjson line's arrival."""
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+            t0 = time.monotonic()
+            conn.request("POST", "/v1/generate",
+                         body=json.dumps({**body, "stream": True}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                resp.read()
+                conn.close()
+                return None, 0, resp.status, True
+            first = resp.readline()
+            ttft = time.monotonic() - t0
+            rest = resp.read()
+            conn.close()
+            toks = 0
+            err = False
+            for ln in (first + rest).splitlines():
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    err = True
+                    continue
+                if "token" in rec:
+                    toks += 1
+                if "error" in rec:
+                    err = True
+            return ttft, toks, 200, err
+
+        # Warm the whole path untimed (compiles: both prefill buckets,
+        # insert, decode chunks, the prefix-extension program, and — on
+        # the disagg arm — the export/import seams), so the timed flood
+        # measures architecture, not compilation.
+        for prompt, gen in (workload[0], workload[1], workload[2]):
+            post_stream({"promptTokens": prompt, "maxNewTokens": gen,
+                         "prefixId": "agent"})
+
+        ttfts: list = []
+        totals = [0]
+        failures = [0]
+        lock = threading.Lock()
+        t0 = time.monotonic()
+
+        def session(i: int) -> None:
+            prompt, gen = workload[i]
+            ttft, toks, status, err = post_stream(
+                {"promptTokens": prompt, "maxNewTokens": gen,
+                 "prefixId": "agent"})
+            with lock:
+                if status != 200 or err:
+                    failures[0] += 1
+                if ttft is not None:
+                    ttfts.append(ttft)
+                totals[0] += toks
+
+        threads = [threading.Thread(target=session, args=(i,))
+                   for i in range(n_sessions)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.monotonic() - t0
+
+        ttfts.sort()
+        h = gw.registry.get("kukeon_handoff_seconds")
+        handoff_p50 = h.percentile(0.5)
+        out = {
+            "roles": list(roles),
+            "ttft_p50_s": round(ttfts[len(ttfts) // 2], 4) if ttfts else None,
+            "ttft_p95_s": (round(ttfts[min(len(ttfts) - 1,
+                                           int(len(ttfts) * 0.95))], 4)
+                           if ttfts else None),
+            "tok_per_s": round(totals[0] / wall, 2),
+            "tokens": totals[0],
+            "wall_s": round(wall, 2),
+            "failed": failures[0],
+            "handoff_ms_p50": (round(handoff_p50 * 1000, 2)
+                               if handoff_p50 is not None else None),
+            "handoffs": int(sum(h.snapshot()[0])),
+            "handoff_pages": int(gw.registry.get(
+                "kukeon_handoff_pages_total").value()),
+            "handoff_bytes": int(gw.registry.get(
+                "kukeon_handoff_bytes_total").value()),
+            "handoff_fallbacks": int(gw.registry.get(
+                "kukeon_handoff_fallback_total").value()),
+        }
+        gw_srv.shutdown()
+        gw.stop()
+        for srv in servers:
+            srv.shutdown()
+        for cell in cells:
+            cell.engine.stop()
+        return out
+
+    _log("disagg: mixed arm (2x mixed)...")
+    mixed = run_arm(("mixed", "mixed"))
+    _log(f"disagg mixed arm: {mixed}")
+    _log("disagg: disaggregated arm (1 prefill + 1 decode)...")
+    disagg = run_arm(("prefill", "decode"))
+    _log(f"disagg arm: {disagg}")
+
+    line = {
+        "metric": (f"disaggregated vs mixed serving, tiny, {n_sessions} "
+                   f"bimodal sessions, equal KV HBM, {n_chips} chip(s) "
+                   f"[{backend}]"),
+        "backend": backend,
+        "n_chips": n_chips,
+        "model": "tiny",
+        "kv_page_tokens": pt,
+        "arms": {"mixed": mixed, "disagg": disagg},
+        "ttft_p95_gain": (round(mixed["ttft_p95_s"] / disagg["ttft_p95_s"], 3)
+                          if mixed["ttft_p95_s"] and disagg["ttft_p95_s"]
+                          else None),
+        "tok_per_s_ratio": round(
+            disagg["tok_per_s"] / max(1e-9, mixed["tok_per_s"]), 3),
+        "handoff_ms_p50": disagg["handoff_ms_p50"],
+    }
+    if backend == "tpu":
+        try:
+            with open(os.path.join(REPO, "BENCH_TPU_HISTORY.jsonl"), "a") as f:
+                f.write(json.dumps({
+                    "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    "note": "disaggregated prefill/decode", **line,
+                }) + "\n")
+        except OSError:
+            pass
+    if args.out:
+        serve = {
+            "backend": backend, "n_chips": n_chips, "model": "tiny",
+            "model_id": "tiny", "sessions": n_sessions, "replicas": 2,
+            "tok_per_s": disagg["tok_per_s"],
+            "trials": [disagg["tok_per_s"]],
+            "kv_page_tokens": pt,
+            "ttft_p95_s": disagg["ttft_p95_s"],
+        }
+        write_artifact(args.out, serve, {
+            "disagg": line, "handoff_ms_p50": disagg["handoff_ms_p50"]})
+    print(json.dumps(line), flush=True)
+
+
 def phase_gateway(args) -> None:
     """Scale-out serving through the replica gateway (`--replicas N`): N
     in-process serving cells behind a GatewayCell, flooded by concurrent
@@ -926,10 +1141,15 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", default="all",
                     choices=["all", "serve", "embed", "ab", "autotune",
-                             "gateway", "mixed"])
+                             "gateway", "mixed", "disagg"])
     # Mixed agent-session workload at fixed KV HBM (phase_mixed): legacy
     # vs paged engine, max concurrent sessions + aggregate tok/s per arm.
     ap.add_argument("--mixed", action="store_true")
+    # Disaggregated prefill/decode acceptance bench (phase_disagg, run as
+    # `--mixed --disagg`): the bimodal workload against a 1-prefill +
+    # 1-decode split vs the same cells mixed, through the real gateway;
+    # client-side TTFT p95 per arm + the handoff cost histogram.
+    ap.add_argument("--disagg", action="store_true")
     # Scale-out routing benchmark: stand up a replica gateway + N in-process
     # replicas and measure aggregate tok/s + retry rate through the proxy.
     ap.add_argument("--replicas", type=int, default=1)
@@ -950,15 +1170,19 @@ def main() -> None:
     # contiguous layout; > 0 = block-table page pool with this page size.
     ap.add_argument("--kv-page-tokens", type=int, default=None)
     # Standardized trajectory artifact (e.g. --out BENCH_r06.json): one
-    # schema-versioned JSON file per run (kukeon-bench/v2; read_artifact
-    # upgrades v1 points) with percentiles, throughput, compile counts,
-    # peak HBM, and the replica count, so BENCH_*.json points stay
-    # comparable across rounds regardless of how the console line evolves.
+    # schema-versioned JSON file per run (kukeon-bench/v4; read_artifact
+    # upgrades v1-v3 points) with percentiles, throughput, compile counts,
+    # peak HBM, replica count, and the disaggregation section, so
+    # BENCH_*.json points stay comparable across rounds regardless of how
+    # the console line evolves.
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
     if args.autotune or args.phase == "autotune":
         phase_autotune(args)
+        return
+    if args.disagg or args.phase == "disagg":
+        phase_disagg(args)
         return
     if args.mixed or args.phase == "mixed":
         phase_mixed(args)
@@ -1123,20 +1347,26 @@ def read_artifact(path: str) -> dict:
     kukeon-bench/v1 point (pre-gateway) is a single-engine measurement and
     gains ``replicas: 1``; v1/v2 points (pre-paged-KV) gain
     ``kv_page_tokens: 0`` (the legacy contiguous layout) and
-    ``max_sessions`` equal to their session count (every session a legacy
-    point ran was concurrently resident)."""
+    ``max_sessions`` equal to their session count; v1–v3 points
+    (pre-disaggregation) gain ``ttft_p95_s`` (lifted from their latency
+    percentiles when present), ``handoff_ms_p50: None`` (no KV handoff
+    existed), and ``disagg: None``."""
     with open(path) as f:
         artifact = json.load(f)
     schema = artifact.get("schema")
     if schema not in ("kukeon-bench/v1", "kukeon-bench/v2",
-                     "kukeon-bench/v3"):
+                      "kukeon-bench/v3", "kukeon-bench/v4"):
         raise ValueError(f"unknown bench artifact schema {schema!r} in {path}")
-    if schema != "kukeon-bench/v3":
+    if schema != "kukeon-bench/v4":
         artifact = dict(artifact)
         artifact.setdefault("replicas", 1)              # v1 -> v2
         artifact.setdefault("kv_page_tokens", 0)        # v2 -> v3
         artifact.setdefault("max_sessions", artifact.get("sessions"))
-        artifact["schema"] = "kukeon-bench/v3"
+        lat = ((artifact.get("latency_s") or {}).get("ttft") or {})
+        artifact.setdefault("ttft_p95_s", lat.get("p95"))   # v3 -> v4
+        artifact.setdefault("handoff_ms_p50", None)
+        artifact.setdefault("disagg", None)
+        artifact["schema"] = "kukeon-bench/v4"
     return artifact
 
 
@@ -1144,7 +1374,7 @@ def write_artifact(path: str, serve: dict, result: dict) -> None:
     """The standardized BENCH_rNN.json trajectory point: fixed schema, one
     file per run, every field from the product's own instruments."""
     artifact = {
-        "schema": "kukeon-bench/v3",
+        "schema": "kukeon-bench/v4",
         "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "backend": serve["backend"],
         "n_chips": serve["n_chips"],
@@ -1168,6 +1398,15 @@ def write_artifact(path: str, serve: dict, result: dict) -> None:
             "kv_page_tokens", (serve.get("config") or {}).get(
                 "kv_page_tokens", 0)),
         "max_sessions": serve.get("max_sessions", serve.get("sessions")),
+        # v4: client-observable TTFT p95 (lifted from the engine latency
+        # percentiles when the phase measured no client-side number), and
+        # the disaggregated-serving section (KV handoff cost + per-arm
+        # TTFT/throughput) when `--mixed --disagg` produced one.
+        "ttft_p95_s": serve.get(
+            "ttft_p95_s",
+            ((serve.get("latency_s") or {}).get("ttft") or {}).get("p95")),
+        "handoff_ms_p50": result.get("handoff_ms_p50"),
+        "disagg": result.get("disagg"),
         "cold_start": result.get("cold_start"),
         "embedding": result.get("embedding"),
         "mixed": result.get("mixed"),

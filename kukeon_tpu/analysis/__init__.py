@@ -22,6 +22,9 @@ KUKE008  ``kukeon_*`` metric family missing from the README reference table
 KUKE009  sub-10ms ``time.sleep`` polling loop (busy-wait in disguise)
 KUKE010  span phase/mark literal not declared in ``obs/trace.py`` PHASES
          (or stale declaration, or a dynamic phase name)
+KUKE011  built-in alert rule references a metric family no module declares
+KUKE012  raw device transfer in KV export/import (handoff) code outside the
+         counted ``_fetch``/``_upload``/``sanitize.blocking`` seams
 ======== =====================================================================
 
 Zero-dependency by design (stdlib ``ast`` only): importable and runnable

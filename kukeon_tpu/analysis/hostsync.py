@@ -270,3 +270,109 @@ def check_host_sync(sources: Sequence[SourceFile],
                     continue
                 findings.extend(_seed_and_check(meth, node.name, src.rel))
     return findings
+
+
+# --- KUKE012: KV handoff transfer discipline ---------------------------------
+#
+# The disaggregated prefill/decode handoff moves whole KV blocks between
+# cells — by far the largest per-request transfers in the tree. Every byte
+# must cross the device boundary through the counted seams
+# (``self._fetch`` / ``self._upload``, or an explicit
+# ``sanitize.blocking(...)``-marked section), or the handoff's cost is
+# invisible to ``sync_stats``, the ``kukeon_engine_host_sync_*``
+# exposition, AND the kukesan blocking-under-hot-lock checks. This pass
+# scopes to export/import-named methods in the serving engine and cell —
+# the code that owns handoff bytes — and flags raw transfer primitives
+# there; the generic hot-path discipline stays KUKE001/002's job.
+
+import re as _re
+
+HANDOFF_FILE_SUFFIXES = (ENGINE_FILE_SUFFIX, "runtime/serving_cell.py")
+# Methods/functions owning handoff bytes: anything whose name carries an
+# export/import marker (``kv_export``, ``_dispatch_prefill_export``,
+# ``_finish_export``, ``_dispatch_import``, ``kv_import_stream``...).
+# ``pack_kv``/``unpack_kv`` (pure host serialization) are covered too —
+# a device transfer has no business appearing in them at all.
+HANDOFF_NAME_RE = _re.compile(
+    r"(^|_)(export|import)(ed)?(_|$)|(^|_)kv(_|$)")
+
+
+def _handoff_findings(fn: ast.FunctionDef, scope: str,
+                      rel: str) -> list[Finding]:
+    taint = _Taint()
+    findings: list[Finding] = []
+
+    def flag(node: ast.Call) -> None:
+        base, attr = _call_name(node)
+        if base == "jax" and attr in ("device_get", "device_put"):
+            findings.append(Finding(
+                "KUKE012", rel, node.lineno,
+                f"raw `jax.{attr}` in KV handoff code ({scope}); handoff "
+                f"bytes must move through the counted transfer seams "
+                f"(self._fetch / self._upload / sanitize.blocking)",
+                scope=scope, detail=f"jax.{attr}"))
+            return
+        if base == "jnp" and attr in JNP_UPLOADS:
+            findings.append(Finding(
+                "KUKE012", rel, node.lineno,
+                f"raw `jnp.{attr}` upload in KV handoff code ({scope}); "
+                f"route the block through self._upload so the handoff's "
+                f"transfer cost is counted",
+                scope=scope, detail=f"jnp.{attr}"))
+            return
+        if (base == "np" and attr in ("asarray", "array") and node.args
+                and taint.expr_is_device(node.args[0])):
+            findings.append(Finding(
+                "KUKE012", rel, node.lineno,
+                f"`np.{attr}` on a device value in KV handoff code "
+                f"({scope}) is a blocking uncounted readback; route the "
+                f"block through self._fetch",
+                scope=scope, detail=f"np.{attr}"))
+
+    # Reuse the host-sync taint model (device values = jitted program
+    # results, device self attrs, jnp results) with a minimal assignment
+    # propagation — handoff methods are straight-line.
+    def propagate(node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                dev = taint.expr_is_device(sub.value)
+                for tgt in sub.targets:
+                    if dev and isinstance(tgt, ast.Name):
+                        taint.device.add(tgt.id)
+                    elif dev and isinstance(tgt, (ast.Tuple, ast.List)):
+                        for elt in tgt.elts:
+                            if isinstance(elt, ast.Name):
+                                taint.device.add(elt.id)
+
+    propagate(fn)
+    propagate(fn)   # second sweep: loop-carried taint
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            flag(sub)
+    return findings
+
+
+@register_pass(("KUKE012",))
+def check_handoff_transfers(sources: Sequence[SourceFile],
+                            package_root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in sources:
+        if not any(src.rel.endswith(sfx) for sfx in HANDOFF_FILE_SUFFIXES):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for meth in node.body:
+                if not isinstance(meth, ast.FunctionDef):
+                    continue
+                if meth.name in SEAM_METHODS:
+                    continue
+                if not HANDOFF_NAME_RE.search(meth.name):
+                    continue
+                findings.extend(_handoff_findings(
+                    meth, f"{node.name}.{meth.name}", src.rel))
+        for node in src.tree.body:
+            if (isinstance(node, ast.FunctionDef)
+                    and HANDOFF_NAME_RE.search(node.name)):
+                findings.extend(_handoff_findings(node, node.name, src.rel))
+    return findings

@@ -54,6 +54,7 @@ POINTS = (
     "engine.fetch",
     "engine.upload",
     "kv.alloc",
+    "kv.handoff",
     "cell.http",
     "checkpoint.save",
     "checkpoint.load",

@@ -104,6 +104,31 @@ class GatewayCell:
         self._m_routing = reg.counter(
             "kukeon_gateway_routing_total",
             "Routing decisions by policy.", labels=("policy",))
+        # Disaggregated-serving KV handoff telemetry: the gateway drives
+        # the prefill-export -> decode-import hop, so the cost of moving a
+        # request's KV between cells is measured HERE, where both halves
+        # are visible. Families are declared unconditionally so a mixed
+        # deployment scrapes stable zeros.
+        self._m_handoff_pages = reg.counter(
+            "kukeon_handoff_pages_total",
+            "KV pages moved prefill->decode across completed handoffs "
+            "(1/handoff when the exporter runs the contiguous layout).")
+        self._m_handoff_bytes = reg.counter(
+            "kukeon_handoff_bytes_total",
+            "Serialized KV bytes moved prefill->decode.")
+        self._m_handoff_seconds = reg.histogram(
+            "kukeon_handoff_seconds",
+            "Wall time of one KV handoff: export POST through import "
+            "response headers (prefill compute + both transfer legs).")
+        self._m_handoff_failures = reg.counter(
+            "kukeon_handoff_failures_total",
+            "Handoff stage failures (connect error / 5xx / exhausted "
+            "retries), by stage.", labels=("stage",))
+        self._m_handoff_fallback = reg.counter(
+            "kukeon_handoff_fallback_total",
+            "Requests that degraded to single-cell local decode after a "
+            "handoff stage failed (the graceful path — client still gets "
+            "200).")
         ready_g = reg.gauge("kukeon_gateway_replica_ready",
                             "1 while the replica is in rotation.",
                             labels=("replica",))
@@ -168,8 +193,56 @@ class GatewayCell:
             conn.close()
             raise
 
+    def _try_replica(self, rep, path: str, body: bytes,
+                     fwd_headers: "dict[str, str] | None", span=None,
+                     stage: str | None = None):
+        """One dial of one replica with the shared demotion/retry
+        accounting (connect error and 429/503 are retryable — demote,
+        count, record the hop on the span). Returns
+        ``("response", conn, resp)`` for anything else (the caller owns
+        closing and ``rep.end()``), or ``("retry", last_tuple)`` with
+        everything already closed."""
+        stage_attrs = {"stage": stage} if stage else {}
+        rep.begin()
+        try:
+            conn, resp = self._open(rep, path, body, fwd_headers)
+        except OSError as e:
+            rep.end()
+            self.router.mark_unready(rep)
+            self._m_requests.inc(replica=rep.name, outcome="connect_error")
+            self._m_retries.inc(reason="connect_error")
+            if span is not None:
+                span.event("proxy_retry", replica=rep.name,
+                           reason="connect_error", **stage_attrs)
+                span.attrs["retries"] = (
+                    span.attrs.get("retries", 0) + 1)
+            return ("retry", (rep.name, None, str(e), None))
+        if resp.status in (429, 503):
+            payload = resp.read()
+            retry_after = resp.getheader("Retry-After")
+            conn.close()
+            rep.end()
+            if resp.status == 503:
+                # Lifecycle refusal (draining / warming / wedged): out
+                # of rotation until a poll says otherwise. 429 is queue
+                # pressure — the replica stays routable for others.
+                self.router.mark_unready(rep)
+            self._m_requests.inc(
+                replica=rep.name,
+                outcome="shed" if resp.status == 429 else "unready")
+            self._m_retries.inc(reason=f"status_{resp.status}")
+            if span is not None:
+                span.event("proxy_retry", replica=rep.name,
+                           reason=f"status_{resp.status}", **stage_attrs)
+                span.attrs["retries"] = (
+                    span.attrs.get("retries", 0) + 1)
+            return ("retry", (rep.name, resp.status, payload, retry_after))
+        return ("response", conn, resp)
+
     def select_and_proxy(self, path: str, body: bytes,
-                         prefix_id: str | None, span=None):
+                         prefix_id: str | None, span=None,
+                         pool: str | None = None,
+                         exclude: "set[str] | None" = None):
         """Route with bounded retry until a replica yields a non-retryable
         response. Returns one of:
 
@@ -179,8 +252,14 @@ class GatewayCell:
         A 2xx "response" may still be a stream the caller relays; the
         replica's inflight counter was incremented via ``rep.begin()`` and
         the caller must ``rep.end()`` when done with the response.
+
+        ``pool`` restricts routing to a role pool (the handoff fallback
+        routes over prefill-capable replicas); ``exclude`` seeds the
+        per-replica once-per-request set with replicas an earlier handoff
+        stage already burned, so the fallback never re-dials a replica
+        this request has seen fail.
         """
-        excluded: set[str] = set()
+        excluded: set[str] = set(exclude or ())
         last: tuple | None = None   # (replica_name, status, body, retry_after)
         repolled = False
         attempts = 0
@@ -192,7 +271,8 @@ class GatewayCell:
                 span.trace_id, span.span_id)}
             if span is not None else None)
         while attempts < max(1, len(self.router.replicas)):
-            rep, policy = self.router.pick(prefix_id, exclude=excluded)
+            rep, policy = self.router.pick(prefix_id, exclude=excluded,
+                                           pool=pool)
             if rep is None:
                 if not repolled:
                     # The routable set can look empty for one poll interval
@@ -209,45 +289,12 @@ class GatewayCell:
             self._m_routing.inc(policy=policy)
             if span is not None:
                 span.event("proxy_attempt", replica=rep.name, policy=policy)
-            rep.begin()
-            try:
-                conn, resp = self._open(rep, path, body, fwd_headers)
-            except OSError as e:
-                rep.end()
-                self.router.mark_unready(rep)
-                self._m_requests.inc(replica=rep.name, outcome="connect_error")
-                self._m_retries.inc(reason="connect_error")
-                if span is not None:
-                    span.event("proxy_retry", replica=rep.name,
-                               reason="connect_error")
-                    span.attrs["retries"] = (
-                        span.attrs.get("retries", 0) + 1)
+            got = self._try_replica(rep, path, body, fwd_headers, span)
+            if got[0] == "retry":
                 excluded.add(rep.name)
-                last = (rep.name, None, str(e), None)
+                last = got[1]
                 continue
-            if resp.status in (429, 503):
-                payload = resp.read()
-                retry_after = resp.getheader("Retry-After")
-                conn.close()
-                rep.end()
-                if resp.status == 503:
-                    # Lifecycle refusal (draining / warming / wedged): out
-                    # of rotation until a poll says otherwise. 429 is queue
-                    # pressure — the replica stays routable for others.
-                    self.router.mark_unready(rep)
-                self._m_requests.inc(
-                    replica=rep.name,
-                    outcome="shed" if resp.status == 429 else "unready")
-                self._m_retries.inc(reason=f"status_{resp.status}")
-                if span is not None:
-                    span.event("proxy_retry", replica=rep.name,
-                               reason=f"status_{resp.status}")
-                    span.attrs["retries"] = (
-                        span.attrs.get("retries", 0) + 1)
-                excluded.add(rep.name)
-                last = (rep.name, resp.status, payload, retry_after)
-                continue
-            return ("response", rep, conn, resp)
+            return ("response", rep, got[1], got[2])
         # Every replica refused or nothing was routable.
         if span is not None:
             span.event("proxy_shed")
@@ -260,6 +307,185 @@ class GatewayCell:
                             "retryAfterSeconds": GATEWAY_RETRY_AFTER_S}
                            ).encode(),
                 str(GATEWAY_RETRY_AFTER_S))
+
+    # --- disaggregated two-stage routing (KV handoff) ----------------------
+
+    def handoff_and_proxy(self, req: dict, body: bytes,
+                          prefix_id: str | None, stream: bool, span=None):
+        """Two-stage routing for ``/v1/generate`` when the replica census
+        declares roles: export the prompt's KV from a prefill replica
+        (picked by queue depth), import it into a decode replica (picked by
+        the same rendezvous prefix affinity as the mixed path), and hand
+        the decode replica's live response back for relaying. Both hops
+        carry this span's traceparent, so the prefill-cell and decode-cell
+        engine spans land as children of ONE gateway span.
+
+        Degradation contract (the ``kv.handoff`` robustness satellite):
+        any stage failing — import 5xx, decode replica dead or shedding,
+        no decode replica ready — falls back to single-cell local decode
+        on a prefill-capable replica instead of surfacing a handoff 5xx;
+        the client sees 200, or the usual 429/503 shed when genuinely
+        nothing has capacity.
+
+        Returns select_and_proxy's shapes plus
+        ``("inline", status, payload, content_type)`` when the gateway can
+        answer from the export header alone (first token already
+        terminal, or a 400 passing through)."""
+        t0 = time.monotonic()
+        excluded: set[str] = set()   # hard: connect error / 429 / 503
+        soft: set[str] = set()       # handoff-5xx: still fallback-eligible
+        fwd_headers = (
+            {obs_trace.TRACEPARENT_HEADER: obs_trace.format_traceparent(
+                span.trace_id, span.span_id)}
+            if span is not None else None)
+
+        def fallback(stage: str):
+            if span is not None:
+                span.event("handoff_fallback", stage=stage)
+            self._m_handoff_fallback.inc()
+            return self.select_and_proxy("/v1/generate", body, prefix_id,
+                                         span=span, pool="prefill",
+                                         exclude=excluded)
+
+        # --- stage 1: prefill export (queue-depth pick) --------------------
+        export_req = dict(req)
+        export_req.pop("stream", None)
+        ebody = json.dumps(export_req).encode()
+        export = None
+        last: tuple | None = None
+        repolled = False
+        attempts = 0
+        while attempts < max(1, len(self.router._pool_members("prefill"))):
+            rep, policy = self.router.pick_prefill(exclude=excluded | soft)
+            if rep is None:
+                if not repolled:
+                    repolled = True
+                    self.router.poll_once()
+                    continue
+                break
+            attempts += 1
+            self._m_routing.inc(policy=policy)
+            if span is not None:
+                span.event("proxy_attempt", replica=rep.name, policy=policy,
+                           stage="export")
+            got = self._try_replica(rep, "/v1/kv/export", ebody, fwd_headers,
+                                    span, stage="export")
+            if got[0] == "retry":
+                excluded.add(rep.name)
+                last = got[1]
+                continue
+            _tag, conn, resp = got
+            if resp.status != 200:
+                payload = resp.read()
+                ctype = resp.getheader("Content-Type") or "application/json"
+                conn.close()
+                rep.end()
+                self._m_requests.inc(replica=rep.name,
+                                     outcome=f"status_{resp.status}")
+                if resp.status == 400:
+                    # The client's problem — pass it through untouched.
+                    return ("inline", 400, payload, ctype)
+                self._m_handoff_failures.inc(stage="export")
+                soft.add(rep.name)
+                continue
+            data = resp.read()
+            conn.close()
+            rep.end()
+            self._m_requests.inc(replica=rep.name, outcome="ok")
+            nl = data.find(b"\n")
+            try:
+                header = json.loads(data[:max(nl, 0)])
+            except ValueError:
+                self._m_handoff_failures.inc(stage="export")
+                soft.add(rep.name)
+                continue
+            export = (rep.name, header, data[nl + 1:])
+            break
+        if export is None:
+            if last is not None and last[1] in (429, 503):
+                # Every prefill-capable replica shed: same passthrough
+                # semantics as the single-hop path.
+                if span is not None:
+                    span.event("proxy_shed")
+                self._m_shed.inc()
+                return ("shed", last[1], last[2], last[3])
+            return fallback("export")
+
+        prefill_name, header, raw = export
+        if header.get("done"):
+            # The first token is already terminal (eos / stop / one-token
+            # budget): no decode hop needed — answer from the header.
+            first = int(header.get("token", -1))
+            text = header.get("text") or ""
+            secs = round(time.monotonic() - t0, 4)
+            if stream:
+                payload = (
+                    json.dumps({"token": first, "text": text}) + "\n"
+                    + json.dumps({"done": True, "tokens": [first],
+                                  "text": text, "numTokens": 1,
+                                  "seconds": secs}) + "\n").encode()
+                return ("inline", 200, payload, "application/x-ndjson")
+            payload = json.dumps({"tokens": [first], "text": text,
+                                  "numTokens": 1, "seconds": secs}).encode()
+            return ("inline", 200, payload, "application/json")
+
+        # --- stage 2: decode import (prefix affinity pick) -----------------
+        imp_header = dict(header)
+        imp_header["stream"] = bool(stream)
+        ibody = json.dumps(imp_header).encode() + b"\n" + raw
+        repolled = False
+        attempts = 0
+        while attempts < max(1, len(self.router._pool_members("decode"))):
+            rep, policy = self.router.pick_decode(prefix_id,
+                                                  exclude=excluded | soft)
+            if rep is None:
+                if not repolled:
+                    repolled = True
+                    self.router.poll_once()
+                    continue
+                break
+            attempts += 1
+            self._m_routing.inc(policy=policy)
+            if span is not None:
+                span.event("proxy_attempt", replica=rep.name, policy=policy,
+                           stage="import")
+            got = self._try_replica(rep, "/v1/kv/import", ibody, fwd_headers,
+                                    span, stage="import")
+            if got[0] == "retry":
+                excluded.add(rep.name)
+                if got[1][1] is None:
+                    # Connect failure = the decode replica died mid-
+                    # handoff; a 429/503 is ordinary shedding, not a
+                    # handoff fault.
+                    self._m_handoff_failures.inc(stage="import")
+                continue
+            _tag, conn, resp = got
+            if resp.status != 200:
+                payload = resp.read()
+                ctype = resp.getheader("Content-Type") or "application/json"
+                conn.close()
+                rep.end()
+                self._m_requests.inc(replica=rep.name,
+                                     outcome=f"status_{resp.status}")
+                if resp.status == 400:
+                    return ("inline", 400, payload, ctype)
+                self._m_handoff_failures.inc(stage="import")
+                soft.add(rep.name)
+                continue
+            # Handoff complete: account the move and relay the live
+            # response (the import stream carries the first token line
+            # the moment the decode cell emits it).
+            n = int(header.get("length") or 0)
+            pt = int(header.get("pageTokens") or 0)
+            pages = (n // pt + 1) if pt else 1
+            self._m_handoff_pages.inc(pages)
+            self._m_handoff_bytes.inc(len(raw))
+            self._m_handoff_seconds.observe(time.monotonic() - t0)
+            if span is not None:
+                span.event("kv_handoff", prefill=prefill_name,
+                           decode=rep.name, pages=pages, bytes=len(raw))
+            return ("response", rep, conn, resp)
+        return fallback("import")
 
     def stats(self) -> dict:
         reg = self.registry
@@ -378,7 +604,21 @@ def make_gateway_handler(gw: GatewayCell):
             # attempt lands on it and the downstream hop inherits it.
             span = gw.begin_span(path, obs_trace.parse_traceparent(
                 self.headers.get(obs_trace.TRACEPARENT_HEADER)))
-            got = gw.select_and_proxy(path, body, prefix_id, span=span)
+            if path == "/v1/generate" and gw.router.disaggregated():
+                # Role census says this fleet is disaggregated: drive the
+                # two-stage prefill-export -> decode-import handoff.
+                got = gw.handoff_and_proxy(req, body, prefix_id, stream,
+                                           span=span)
+            else:
+                got = gw.select_and_proxy(path, body, prefix_id, span=span)
+            if got[0] == "inline":
+                # The gateway answered from the export header (terminal
+                # first token) or passes a 400 through.
+                _tag, status, payload, ctype = got
+                self._send_raw(status, payload or b"{}", ctype)
+                gw.finish_span(span, "ok" if status < 400 else "error",
+                               status=status)
+                return
             if got[0] == "shed":
                 _tag, status, payload, retry_after = got
                 secs = float(retry_after or GATEWAY_RETRY_AFTER_S)

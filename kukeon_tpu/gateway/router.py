@@ -50,6 +50,11 @@ class ReplicaState:
         self.queue_depth = 0
         self.poll_ok = False
         self.last_poll_at = 0.0
+        # Disaggregation role census (mixed | prefill | decode), learned
+        # from /v1/stats polls. Sticky across poll failures: a dead decode
+        # replica must stay counted as the decode pool's member so the
+        # gateway knows to FALL BACK rather than silently de-disaggregate.
+        self.role = "mixed"
         # Gateway-side in-flight proxied requests: fresher than the polled
         # queue depth, used as the tiebreaker between equally-deep queues.
         self._inflight_lock = sanitize.lock(
@@ -71,6 +76,7 @@ class ReplicaState:
         return {
             "name": self.name,
             "url": self.url,
+            "role": self.role,
             "ready": self.ready,
             "draining": self.draining,
             "queueDepth": self.queue_depth,
@@ -78,10 +84,23 @@ class ReplicaState:
             "pollOk": self.poll_ok,
         }
 
+    def prefill_capable(self) -> bool:
+        """Can run a prefill (or a whole request): prefill and mixed roles.
+        This is also the local-decode fallback pool — role is routing
+        policy, not engine capability, so a prefill cell CAN decode when
+        the decode pool is gone."""
+        return self.role in ("prefill", "mixed")
+
+    def decode_capable(self) -> bool:
+        return self.role in ("decode", "mixed")
+
 
 POLICY_AFFINITY = "affinity"
 POLICY_AFFINITY_FALLBACK = "affinity_fallback"
 POLICY_LEAST_LOADED = "least_loaded"
+# Two-stage (disaggregated) routing policies: prefill hop by queue depth,
+# decode hop by the same rendezvous affinity the mixed path uses.
+POLICY_PREFILL_QUEUE = "prefill_queue_depth"
 
 
 @sanitize.guard_class
@@ -114,6 +133,9 @@ class Router:
                 rep.draining = bool(stats.get("draining"))
                 rep.queue_depth = int(stats.get("queueDepth") or 0)
                 rep.ready = bool(stats.get("ready", True)) and not rep.draining
+                role = stats.get("role")
+                if role in ("mixed", "prefill", "decode"):
+                    rep.role = str(role)
                 rep.poll_ok = True
             except Exception:  # noqa: BLE001 — an unreachable replica is routing data
                 rep.poll_ok = False
@@ -150,28 +172,80 @@ class Router:
 
     # --- selection ---------------------------------------------------------
 
-    def affine(self, prefix_id: str) -> ReplicaState:
-        """Rendezvous hash over the FULL replica set (not just the ready
-        ones): the mapping must not churn when a replica blips unready, or
-        every blip would scatter warm prefixes across the fleet."""
-        return max(self.replicas, key=lambda r: hashlib.sha256(
+    def affine(self, prefix_id: str,
+               pool: Optional[str] = None) -> Optional[ReplicaState]:
+        """Rendezvous hash over the FULL pool membership (not just the
+        ready members): the mapping must not churn when a replica blips
+        unready, or every blip would scatter warm prefixes across the
+        fleet. ``pool`` narrows to a role pool (two-stage decode routing
+        hashes over decode-capable replicas only); None on an empty pool."""
+        members = self._pool_members(pool)
+        if not members:
+            return None
+        return max(members, key=lambda r: hashlib.sha256(
             f"{prefix_id}|{r.name}".encode()).digest())
 
     def pick(self, prefix_id: Optional[str] = None,
-             exclude: Union[FrozenSet[str], Set[str]] = frozenset()
+             exclude: Union[FrozenSet[str], Set[str]] = frozenset(),
+             pool: Optional[str] = None
              ) -> tuple[Optional[ReplicaState], Optional[str]]:
-        """(replica, policy) — or (None, None) when nothing is routable."""
+        """(replica, policy) — or (None, None) when nothing is routable.
+
+        ``pool`` restricts the candidate set by role capability:
+        ``"prefill"``/``"decode"`` filter to capable replicas (the
+        gateway's local-decode fallback routes over the prefill-capable
+        pool); None keeps the full set — the mixed-manifest default path,
+        byte-identical to before roles existed."""
+        members = self._pool_members(pool)
         policy = POLICY_LEAST_LOADED
         if prefix_id is not None:
-            a = self.affine(prefix_id)
-            if a.ready and a.name not in exclude:
+            a = self.affine(prefix_id, pool=pool)
+            if a is not None and a.ready and a.name not in exclude:
                 return a, POLICY_AFFINITY
             policy = POLICY_AFFINITY_FALLBACK
-        ready = [r for r in self.replicas
+        ready = [r for r in members
                  if r.ready and r.name not in exclude]
         if not ready:
             return None, None
         return min(ready, key=lambda r: (r.load(), r.name)), policy
+
+    # --- two-stage (disaggregated) selection -------------------------------
+
+    def _pool_members(self, pool: Optional[str]) -> list[ReplicaState]:
+        if pool == "prefill":
+            return [r for r in self.replicas if r.prefill_capable()]
+        if pool == "decode":
+            return [r for r in self.replicas if r.decode_capable()]
+        return list(self.replicas)
+
+    def disaggregated(self) -> bool:
+        """True when the replica set declares dedicated roles — the
+        gateway then drives /v1/generate as the two-stage
+        prefill-export → decode-import handoff. An all-``mixed`` census
+        (the default) keeps the single-hop path exactly as today."""
+        return any(r.role != "mixed" for r in self.replicas)
+
+    def pick_prefill(self,
+                     exclude: Union[FrozenSet[str], Set[str]] = frozenset()
+                     ) -> tuple[Optional[ReplicaState], Optional[str]]:
+        """Stage-1 pick: least queue depth over the ready prefill pool.
+        Prefill is compute-bound and stateless across requests — no
+        affinity, just the shallowest queue."""
+        ready = [r for r in self._pool_members("prefill")
+                 if r.ready and r.name not in exclude]
+        if not ready:
+            return None, None
+        return (min(ready, key=lambda r: (r.load(), r.name)),
+                POLICY_PREFILL_QUEUE)
+
+    def pick_decode(self, prefix_id: Optional[str] = None,
+                    exclude: Union[FrozenSet[str], Set[str]] = frozenset()
+                    ) -> tuple[Optional[ReplicaState], Optional[str]]:
+        """Stage-2 pick: prefix/session affinity over the decode pool (the
+        same rendezvous hash as the mixed path, so a session's imports keep
+        landing on the engine holding its shared-prefix pages), least
+        loaded otherwise."""
+        return self.pick(prefix_id, exclude=exclude, pool="decode")
 
     def ready_count(self) -> int:
         return sum(1 for r in self.replicas if r.ready)

@@ -77,6 +77,9 @@ PHASES = (
     # engine request lifecycle (serving/engine.py)
     "submitted", "admitted", "prefill_dispatched", "first_token",
     "finished", "preempted",
+    # disaggregated KV handoff (serving/engine.py export/import,
+    # gateway/cell.py handoff driver + local-decode fallback)
+    "kv_exported", "kv_imported", "kv_handoff", "handoff_fallback",
     # gateway proxy hops (gateway/cell.py)
     "proxy_attempt", "proxy_retry", "proxy_shed",
     # cell boot phases (runtime/serving_cell.py finish_boot)

@@ -170,6 +170,18 @@ class ModelSpec:
     # prefix-id affinity (kukeon_tpu/gateway). The client-facing endpoint
     # is ``port`` either way; replicas=1 keeps the single-engine shape.
     replicas: int = 1
+    # Disaggregated prefill/decode serving (FlexNPU-style): "mixed" (the
+    # default — every replica serves both phases, byte-identical to the
+    # pre-role behavior), or a comma-separated per-replica role list
+    # ("prefill,decode,decode", one atom per replica in declaration order)
+    # splitting the replica set into a prefill pool and a decode pool
+    # behind the same gateway. The gateway then routes /v1/generate as a
+    # two-stage KV handoff: prefill pool by queue depth, decode pool by
+    # prefix affinity, with page-granular KV transfer between them and
+    # graceful fallback to local decode on a prefill-capable replica when
+    # the decode pool is unavailable. Roles are policy, not capability —
+    # every replica keeps the full engine.
+    role: str = "mixed"
     num_slots: int = 8
     max_seq_len: int | None = None
     checkpoint: str | None = None    # orbax checkpoint dir; random-init if None

@@ -255,6 +255,22 @@ def validate_cell(spec: t.CellSpec, ctx: str, *, in_blueprint: bool = False) -> 
                     f"{ctx}: model port {p} (of replica range "
                     f"{ports[0]}..{ports[-1]}) collides with a container port"
                 )
+        roles = model_roles(m, ctx)
+        if any(r != "mixed" for r in roles):
+            # A heterogeneous fleet must still be able to COMPLETE a
+            # request: at least one replica that can prefill and one that
+            # can decode (mixed counts as both). A lone "prefill" cell
+            # would accept work it can never finish — reject at apply.
+            if not any(r in ("prefill", "mixed") for r in roles):
+                raise InvalidArgument(
+                    f"{ctx}: model.role {m.role!r} declares no prefill-"
+                    "capable replica (prefill or mixed) — nothing could "
+                    "run a prompt's prefill")
+            if not any(r in ("decode", "mixed") for r in roles):
+                raise InvalidArgument(
+                    f"{ctx}: model.role {m.role!r} declares no decode-"
+                    "capable replica (decode or mixed) — nothing could "
+                    "generate tokens")
         if m.num_slots < 1:
             raise InvalidArgument(f"{ctx}: model.numSlots must be >= 1")
         if m.max_seq_len is not None and m.max_seq_len < 16:
@@ -272,6 +288,35 @@ def validate_cell(spec: t.CellSpec, ctx: str, *, in_blueprint: bool = False) -> 
             raise InvalidArgument(
                 f"{ctx}: model.sloAvailability must be a fraction in (0, 1)"
             )
+
+
+_MODEL_ROLES = ("mixed", "prefill", "decode")
+
+
+def model_roles(m: t.ModelSpec, ctx: str | None = None) -> list[str]:
+    """Per-replica role list from ``ModelSpec.role`` (one entry per
+    replica, declaration order — the same order the runner's base-port
+    scheme assigns ports). A single atom applies to every replica; a
+    comma-separated list must name each replica exactly once. Raises
+    InvalidArgument on malformed input when ``ctx`` is given (the validate
+    path); the runner calls it post-validation and may pass None."""
+    n = max(1, m.replicas or 1)
+    raw = (m.role or "mixed").strip()
+    atoms = [a.strip() for a in raw.split(",")] if raw else ["mixed"]
+    where = ctx or "ModelSpec"
+    for a in atoms:
+        if a not in _MODEL_ROLES:
+            raise InvalidArgument(
+                f"{where}: model.role atom {a!r} must be one of "
+                f"{_MODEL_ROLES}")
+    if len(atoms) == 1:
+        return atoms * n
+    if len(atoms) != n:
+        raise InvalidArgument(
+            f"{where}: model.role lists {len(atoms)} roles for "
+            f"{n} replica(s) — give one role per replica (or a single "
+            "role for all)")
+    return atoms
 
 
 def model_ports(m: t.ModelSpec) -> list[int]:

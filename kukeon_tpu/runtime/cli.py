@@ -677,11 +677,20 @@ def render_top(rows, sparks=None) -> str:
             # replica census, QPS the aggregate over replicas; latency/HBM
             # live on the per-replica rows beneath it.
             ready = (f"{r.get('readyReplicas', 0)}/{r.get('replicas', '?')}")
+            extra = f"  (gateway, retries={r.get('retries', 0)}"
+            if r.get("handoffs"):
+                # Disaggregated fleet: how many prefill->decode KV
+                # handoffs this gateway drove, at what median cost.
+                extra += (f", handoffs={r['handoffs']}"
+                          + (f" p50={r['handoffMsP50']}ms"
+                             if r.get("handoffMsP50") is not None else "")
+                          + (f" fallbacks={r['handoffFallbacks']}"
+                             if r.get("handoffFallbacks") else ""))
             lines.append(fmt.format(
                 r["cell"], r.get("model") or "-", ready,
                 f"{r['qps']:.1f}" if r.get("qps") is not None else "-",
                 "-", "-", "-", "-", r.get("restarts", 0))
-                + f"  (gateway, retries={r.get('retries', 0)})")
+                + extra + ")")
             continue
         hbm = "-"
         if r.get("hbmInUseBytes") is not None:
@@ -872,6 +881,17 @@ def _span_detail(span: dict) -> str:
                 parts[-1:] = [f"{parts[-1] if parts else '?'}"
                               f"!{a.get('reason', 'retry')}"]
         bits.append("attempts " + " -> ".join(parts))
+    for e in span.get("events", []):
+        # The disaggregated KV handoff hop: which prefill cell fed which
+        # decode cell, and what the transfer moved.
+        if e.get("event") == "kv_handoff":
+            a = e.get("attrs") or {}
+            bits.append(f"handoff {a.get('prefill', '?')}->"
+                        f"{a.get('decode', '?')} "
+                        f"{a.get('pages', '?')}p/{a.get('bytes', '?')}B")
+        elif e.get("event") == "handoff_fallback":
+            a = e.get("attrs") or {}
+            bits.append(f"handoff fallback (stage {a.get('stage', '?')})")
     if span.get("tokens"):
         bits.append(f"{span['tokens']} tokens")
     if span.get("attrs", {}).get("retries"):

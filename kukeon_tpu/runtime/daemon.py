@@ -387,6 +387,21 @@ def summarize_gateway_scrape(fams: dict) -> dict:
     n = _sample_value(fams, "kukeon_gateway_replicas")
     if n is not None:
         out["replicas"] = int(n)
+    # Disaggregated KV handoff activity: count + p50 cost straight from
+    # the gateway's own histogram (zero on a mixed fleet — the families
+    # are declared unconditionally).
+    hand = fams.get("kukeon_handoff_seconds")
+    if hand is not None:
+        bounds, counts = fed.histogram_counts(hand)
+        total_h = sum(counts)
+        out["handoffs"] = int(total_h)
+        if total_h:
+            p50 = percentile_from_counts(bounds, counts, 0.5)
+            if p50 is not None:
+                out["handoffMsP50"] = round(p50 * 1000, 1)
+        fallbacks = _sample_value(fams, "kukeon_handoff_fallback_total")
+        if fallbacks:
+            out["handoffFallbacks"] = int(fallbacks)
     out["ready"] = bool(out.get("readyReplicas"))
     return out
 

@@ -271,12 +271,15 @@ class Runner:
         deterministically, so a restarted replica gets ITS chips back) plus
         one chip-less ``gateway`` container on ``m.port`` so the
         client-facing endpoint never moves."""
+        from kukeon_tpu.runtime.apply.validate import model_roles
+
         n = m.replicas or 1
+        roles = model_roles(m)
         if n <= 1:
-            return [self._model_container(m)]
+            return [self._model_container(m, role=roles[0])]
         out = [
             self._model_container(m, name=f"model-server-{i}",
-                                  port=m.port + 1 + i)
+                                  port=m.port + 1 + i, role=roles[i])
             for i in range(n)
         ]
         out.append(self._gateway_container(m))
@@ -303,13 +306,19 @@ class Runner:
         )
 
     def _model_container(self, m: t.ModelSpec, *, name: str = "model-server",
-                         port: int | None = None) -> t.ContainerSpec:
+                         port: int | None = None,
+                         role: str = "mixed") -> t.ContainerSpec:
         port = m.port if port is None else port
         cmd = [
             self.opts.serving_python, "-m", "kukeon_tpu.runtime.serving_cell",
             "--model", m.model, "--port", str(port),
             "--num-slots", str(m.num_slots),
         ]
+        if role != "mixed":
+            # Disaggregation role (per replica, declaration order). The
+            # gateway discovers pools from each cell's /v1/stats census, so
+            # the gateway container itself needs no role flags.
+            cmd += ["--role", role]
         if not m.host_network and self.backend.isolated:
             # In-space serving: bind all interfaces so in-space clients reach
             # the server on the cell's bridge IP (the sandbox netns has no

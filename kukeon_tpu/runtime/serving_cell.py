@@ -26,6 +26,13 @@ the mesh over whatever devices JAX exposes and serves:
                          "maxNewTokens": N, "temperature": T,
                          "deadlineS": D, ...}
                         => {"tokens": [...], "text": "..."}
+  POST /v1/kv/export -> generate-shaped JSON body in, binary KV handoff
+                        block out (prefill only — no decode slot consumed);
+                        the disaggregated gateway's first hop
+  POST /v1/kv/import -> binary KV handoff block in, the continuation out
+                        (JSON, or ndjson when the header says stream); the
+                        imported request seats straight into a decode slot
+                        via the paged insert program, never re-prefilling
 
 Resilience: admission is bounded (``--max-pending`` -> 429 + Retry-After),
 requests carry deadlines (``--deadline-s`` default, per-request
@@ -107,6 +114,14 @@ class LifecycleMixin:
         self.drained = sanitize.event("LifecycleMixin.drained")
         self._inflight = 0      # guarded-by: _inflight_lock
         self._inflight_lock = sanitize.lock("LifecycleMixin._inflight_lock")
+        # Drain wake signal (shares _inflight_lock): _inflight_dec notifies
+        # when the HTTP in-flight count hits zero, so the drain loop wakes
+        # the moment the last request finishes instead of sleep-polling
+        # _idle() at 50ms (the same condition-over-poll fix the engine
+        # loop got). The timed wait below doubles as the poll for the
+        # engine-side half of _idle(), which this condition cannot see.
+        self._inflight_zero = sanitize.condition(
+            self._inflight_lock, name="LifecycleMixin._inflight_zero")
         # main() points this at server.shutdown so a finished drain unblocks
         # serve_forever and the process exits 0.
         self.on_drained = None
@@ -183,6 +198,11 @@ class LifecycleMixin:
     def _inflight_dec(self):
         with self._inflight_lock:
             self._inflight -= 1
+            if self._inflight == 0:
+                # Wake a drain loop parked on the condition NOW — the
+                # last in-flight request completing is exactly the event
+                # it is waiting for.
+                self._inflight_zero.notify_all()
 
     def _idle(self) -> bool:
         """No in-flight HTTP requests (subclasses add engine occupancy)."""
@@ -206,7 +226,14 @@ class LifecycleMixin:
         timeout = float(os.environ.get(DRAIN_TIMEOUT_ENV, "30") or 30)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline and not self._idle():
-            time.sleep(0.05)
+            # Park on the inflight-zero condition instead of sleep-polling
+            # (KUKE009 discipline): the last HTTP request's _inflight_dec
+            # wakes the drain immediately; the bounded wait is the safety
+            # net AND the poll tick for engine-side work the condition is
+            # not signalled for (ServingCell._idle also watches
+            # engine._requests).
+            with self._inflight_zero:
+                self._inflight_zero.wait(timeout=0.05)
         self._shutdown_engine()
         self.drained.set()
         if self.on_drained is not None:
@@ -223,6 +250,59 @@ def _trailing_fffd(s: str) -> int:
     while n < len(s) and s[-1 - n] == "�":
         n += 1
     return n
+
+
+# --- KV handoff wire format (disaggregated serving) --------------------------
+#
+# One prefill's output travels prefill cell -> gateway -> decode cell as a
+# single binary body: a JSON header line (token, length, dtype, shape, byte
+# counts, plus — on the import leg — the generation parameters), then the
+# raw K rows, then the raw V rows. JSON-encoding multi-MB bf16 tensors
+# would triple the bytes; this stays a flat memcpy on both ends.
+
+KV_CONTENT_TYPE = "application/x-kukeon-kv"
+
+
+def _kv_dtype(name: str):
+    """numpy dtype from its string name, including the ml_dtypes families
+    (bfloat16 & friends) jax checkpoints use."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_kv(header: dict, k: np.ndarray, v: np.ndarray) -> bytes:
+    """Serialize a KV block + header into the handoff wire format."""
+    kb = np.ascontiguousarray(k).tobytes()
+    vb = np.ascontiguousarray(v).tobytes()
+    head = dict(header)
+    head.update({
+        "dtype": str(k.dtype), "shape": list(k.shape),
+        "kBytes": len(kb), "vBytes": len(vb),
+    })
+    return json.dumps(head).encode() + b"\n" + kb + vb
+
+
+def unpack_kv(body: bytes) -> tuple[dict, np.ndarray, np.ndarray]:
+    """Parse the handoff wire format back into (header, k, v)."""
+    nl = body.find(b"\n")
+    if nl < 0:
+        raise ValueError("KV body has no header line")
+    header = json.loads(body[:nl])
+    dtype = _kv_dtype(header["dtype"])
+    shape = tuple(int(s) for s in header["shape"])
+    kb, vb = int(header["kBytes"]), int(header["vBytes"])
+    raw = body[nl + 1:]
+    if len(raw) != kb + vb:
+        raise ValueError(
+            f"KV body truncated: header claims {kb + vb} tensor bytes, "
+            f"got {len(raw)}")
+    k = np.frombuffer(raw[:kb], dtype=dtype).reshape(shape)
+    v = np.frombuffer(raw[kb:], dtype=dtype).reshape(shape)
+    return header, k, v
 
 
 _CACHE_DIR: str | None = None   # the versioned dir actually configured
@@ -311,7 +391,8 @@ class ServingCell(LifecycleMixin):
                  max_pending: int | None = None,
                  deadline_s: float | None = None,
                  slo_ttft_p95_ms: float | None = None,
-                 slo_availability: float | None = None):
+                 slo_availability: float | None = None,
+                 role: str = "mixed"):
         # Cold-start phase marks (monotonic). "boot_imports" is everything
         # between process start and constructor entry — interpreter boot,
         # module imports, argparse; the remaining phases are stamped as
@@ -395,6 +476,16 @@ class ServingCell(LifecycleMixin):
 
         self.model_name = model
         self.cfg = cfg
+        # Disaggregated-serving role (mixed | prefill | decode). Policy,
+        # not capability: every cell keeps the full engine — a prefill
+        # cell can still decode locally (the gateway's fallback when no
+        # decode replica is ready), a decode cell can still re-prefill a
+        # preempted import. The role is advertised on /v1/stats so the
+        # gateway's two-stage router builds its pools from the census.
+        if role not in ("mixed", "prefill", "decode"):
+            raise SystemExit(
+                f"unknown --role {role!r}; must be mixed|prefill|decode")
+        self.role = role
         # async_load: the multi-GB weight transfer streams in the background
         # while warmup()'s precompile pass AOT-compiles the programs — cold
         # start pays max(transfer, compile) instead of their sum.
@@ -591,15 +682,32 @@ class ServingCell(LifecycleMixin):
                                emit=lambda tok, done: events.put((tok, done)),
                                prefix_id=prefix_id, deadline_s=deadline_s,
                                trace_ctx=trace_ctx)
+        yield from self._stream_events(r, events, stops, tokens=[],
+                                       emitted="", t0=t0)
+
+    def _stream_events(self, r, events, stops, *, tokens, emitted, t0,
+                       skip_first=False):
+        """The shared token-event loop behind generate_stream AND the KV
+        handoff import: drain the engine's emit events, decode by prefix
+        diff, match stop strings, then yield the terminal record.
+        ``tokens``/``emitted`` may arrive pre-seeded (the import path
+        already emitted the handed-off first token before seating);
+        ``skip_first`` swallows the engine's re-emit of that token."""
         driving = not self.engine._running   # direct use without the thread
-        tokens: list[int] = []
-        emitted = ""
         stopped = False
         while True:
             if driving:
                 while events.empty() and not r.done.is_set():
                     self.engine.step()
             tok, done = events.get()
+            if skip_first:
+                # The engine re-emits the imported first token at seat
+                # time; its line already went out pre-seat (the handoff's
+                # TTFT point), so only honor its terminal flag here.
+                skip_first = False
+                if not done:
+                    continue
+                tok = -1
             if tok >= 0 and not stopped:
                 tokens.append(tok)
                 # Incremental decode by prefix diff: decoding ids in
@@ -660,6 +768,147 @@ class ServingCell(LifecycleMixin):
             "stopped": stopped,
         }
 
+    # --- disaggregated serving: KV handoff -------------------------------
+
+    def kv_export(self, req: dict,
+                  trace_ctx: "obs_trace.TraceContext | None" = None) -> bytes:
+        """Prefill-only handler behind ``POST /v1/kv/export``: run the
+        prompt's prefill, fetch the KV block, and serialize it (plus the
+        first sampled token and everything a decode cell needs to seat the
+        request) in the handoff wire format. No decode slot is consumed on
+        this cell — that is what makes a prefill pool's TTFT immune to
+        decode occupancy."""
+        import queue as _q
+
+        prompt, sp, stops, prefix_id, deadline_s = self._parse_generate(req)
+        events: _q.Queue = _q.Queue()
+        r = self.engine.submit(prompt, sp,
+                               emit=lambda tok, done: events.put((tok, done)),
+                               prefix_id=prefix_id, deadline_s=deadline_s,
+                               trace_ctx=trace_ctx, export=True)
+        if not self.engine._running:    # direct use without the thread
+            while not r.done.is_set():
+                self.engine.step()
+        r.done.wait()
+        if r.timed_out:
+            raise DeadlineExceeded(str(r.error))
+        if r.error is not None:
+            if isinstance(r.error, RejectedError):
+                raise r.error
+            raise RuntimeError(f"{type(r.error).__name__}: {r.error}")
+        p = r.export_payload
+        first = int(p["token"])
+        first_text = self.tokenizer.decode([first])
+        # A first token that is already terminal (eos, stop token, a
+        # one-token budget, or a stop string it completes by itself) needs
+        # no decode hop at all — the gateway answers from this header.
+        hit = min((first_text.find(s) for s in stops if s in first_text),
+                  default=-1)
+        done = (hit >= 0
+                or first in self.engine.eos_ids
+                or first in sp.stop_tokens
+                or sp.max_new_tokens <= 1)
+        header = {
+            "token": first,
+            "text": first_text[:hit] if hit >= 0 else first_text,
+            "length": int(p["length"]),
+            "pageTokens": int(p["pageTokens"]),
+            "model": self.model_name,
+            "done": done,
+            # Everything the decode cell needs to seat and continue the
+            # request (tokenized HERE — the gateway has no tokenizer).
+            "promptTokens": [int(t) for t in prompt],
+            "maxNewTokens": sp.max_new_tokens,
+            "temperature": sp.temperature,
+            "topK": sp.top_k,
+            "topP": sp.top_p,
+            "stopTokens": list(sp.stop_tokens),
+            "stop": stops,
+            **({"prefixId": prefix_id} if prefix_id else {}),
+            **({"deadlineS": deadline_s} if deadline_s else {}),
+        }
+        return pack_kv(header, p["k"], p["v"])
+
+    def kv_import_stream(self, header: dict, k: np.ndarray, v: np.ndarray,
+                         trace_ctx: "obs_trace.TraceContext | None" = None):
+        """Seat a prefill cell's exported KV block into this cell's decode
+        batch and stream the continuation (``POST /v1/kv/import``).
+
+        The handed-off first token is emitted BEFORE the request waits for
+        a decode slot — it already exists, so the client's TTFT is the
+        prefill+transfer cost, not prefill plus decode-batch queueing;
+        that ordering is the latency architecture of the handoff. The
+        engine re-emits the token at seat time and the shared event loop
+        swallows it (``skip_first``)."""
+        import queue as _q
+
+        faults.maybe_fail("kv.handoff")
+        first = int(header["token"])
+        n = int(header["length"])
+        prompt = np.asarray(header.get("promptTokens", []), np.int32)
+        stops = list(header.get("stop") or [])
+        from kukeon_tpu.serving import SamplingParams
+
+        sp = SamplingParams(
+            temperature=float(header.get("temperature", 0.0)),
+            top_k=int(header.get("topK", 0)),
+            top_p=float(header.get("topP", 1.0)),
+            max_new_tokens=int(header.get("maxNewTokens", 128)),
+            stop_tokens=tuple(int(t) for t in header.get("stopTokens", [])),
+        )
+        deadline_s = header.get("deadlineS", self.default_deadline_s)
+        t0 = time.monotonic()
+        tokens = [first]
+        full = self.tokenizer.decode(tokens)
+        hit = min((full.find(s) for s in stops if s in full), default=-1)
+        stopped = hit >= 0
+        if stopped:
+            full = full[:hit]
+        done_now = (stopped or first in self.engine.eos_ids
+                    or first in sp.stop_tokens or sp.max_new_tokens <= 1)
+        emitted = (full if done_now
+                   else full[:len(full) - _trailing_fffd(full)])
+        if done_now:
+            with self._stats_lock:
+                self.total_tokens += 1
+            yield {"token": first, "text": emitted}
+            yield {"done": True, "tokens": tokens,
+                   "text": emitted if stops else full,
+                   "numTokens": 1, "seconds": round(
+                       time.monotonic() - t0, 4),
+                   "cancelled": False, "stopped": stopped}
+            return
+        # Submit BEFORE the first yield: a queue-full RejectedError must
+        # surface before any body byte goes out, so the handler can still
+        # answer a clean 429 the gateway's retry accounting understands.
+        events: _q.Queue = _q.Queue()
+        r = self.engine.submit(
+            prompt, sp,
+            emit=lambda tok, done: events.put((tok, done)),
+            prefix_id=header.get("prefixId"), deadline_s=deadline_s,
+            trace_ctx=trace_ctx,
+            kv_import={"token": first, "length": n, "k": k, "v": v})
+        # The handed-off first token goes out NOW, before the request has
+        # a decode slot — TTFT is prefill+transfer, not seat-queue wait.
+        yield {"token": first, "text": emitted}
+        yield from self._stream_events(r, events, stops, tokens=tokens,
+                                       emitted=emitted, t0=t0,
+                                       skip_first=True)
+
+    def kv_import(self, header: dict, k: np.ndarray, v: np.ndarray,
+                  trace_ctx: "obs_trace.TraceContext | None" = None) -> dict:
+        """Non-streaming import: drive the streaming path to its terminal
+        record (one machinery for both modes, like generate)."""
+        out = None
+        for out in self.kv_import_stream(header, k, v, trace_ctx=trace_ctx):
+            pass
+        if out.get("timedOut"):
+            raise DeadlineExceeded(out["error"])
+        if "error" in out:
+            raise RuntimeError(out["error"])
+        return {key: out[key]
+                for key in ("tokens", "text", "numTokens", "seconds")}
+
     def _idle(self) -> bool:
         # _requests is the engine's authoritative unfinished-request map —
         # it covers queued, slotted, AND mid-dispatch requests (queue depth
@@ -681,6 +930,9 @@ class ServingCell(LifecycleMixin):
         ready, unready_why = self.readiness()
         return {
             "model": self.model_name,
+            # Disaggregation role census: the gateway's two-stage router
+            # reads this off every poll to build its prefill/decode pools.
+            "role": self.role,
             "devices": [str(d) for d in jax.devices()],
             "numSlots": int(reg.get("kukeon_engine_slots_total").value()),
             "freeSlots": int(reg.get("kukeon_engine_slots_free").value()),
@@ -936,7 +1188,9 @@ def make_handler(cell: ServingCell):
             self.wfile.write(body)
 
         def _send_text(self, code: int, text: str, content_type: str):
-            body = text.encode()
+            self._send_bytes(code, text.encode(), content_type)
+
+        def _send_bytes(self, code: int, body: bytes, content_type: str):
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
@@ -1049,6 +1303,9 @@ def make_handler(cell: ServingCell):
                 except Exception as e:  # noqa: BLE001 — server must keep serving
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
                 return
+            if self.path in ("/v1/kv/export", "/v1/kv/import"):
+                self._kv_handoff()
+                return
             routes = {}
             if hasattr(cell, "generate"):
                 routes["/v1/generate"] = cell.generate
@@ -1085,6 +1342,53 @@ def make_handler(cell: ServingCell):
                     self._send(200, cell.generate(req, trace_ctx=ctx))
                     return
                 self._send(200, fn(req))
+            except RejectedError as e:
+                self._reject(e)
+            except DeadlineExceeded as e:
+                self._send(504, {"error": str(e), "timedOut": True})
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — server must keep serving
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            finally:
+                if tracked:
+                    cell._inflight_dec()
+
+        def _kv_handoff(self):
+            """The disaggregated-serving KV handoff surface:
+
+            ``POST /v1/kv/export`` — JSON generate-shaped body in, binary
+            KV block (header line + raw K/V rows) out; prefill only, no
+            decode slot consumed.
+            ``POST /v1/kv/import`` — binary KV block in, the continuation
+            out (JSON, or ndjson when the header says ``stream``). Same
+            admission/shed semantics as /v1/generate: lifecycle refusals
+            are 503, engine queue pressure is 429 + Retry-After — the
+            gateway's fallback logic keys off exactly those."""
+            if not hasattr(cell, "kv_export"):
+                self._send(404, {"error": "this cell serves no KV handoff"})
+                return
+            tracked = False
+            try:
+                faults.maybe_fail("cell.http")
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                ctx = obs_trace.parse_traceparent(
+                    self.headers.get(obs_trace.TRACEPARENT_HEADER))
+                cell.check_admission()
+                cell._inflight_inc()
+                tracked = True
+                if self.path == "/v1/kv/export":
+                    req = json.loads(body or b"{}")
+                    self._send_bytes(200, cell.kv_export(req, trace_ctx=ctx),
+                                     KV_CONTENT_TYPE)
+                    return
+                header, k, v = unpack_kv(body)
+                if header.get("stream"):
+                    self._stream(
+                        cell.kv_import_stream(header, k, v, trace_ctx=ctx))
+                    return
+                self._send(200, cell.kv_import(header, k, v, trace_ctx=ctx))
             except RejectedError as e:
                 self._reject(e)
             except DeadlineExceeded as e:
@@ -1157,6 +1461,11 @@ def main(argv=None) -> int:
     # Paged KV cache (ModelSpec kvPageTokens): > 0 = page size in KV rows,
     # 0 = pin the legacy contiguous layout, absent = profile decides.
     ap.add_argument("--kv-page-tokens", type=int, default=None)
+    # Disaggregated serving role (ModelSpec role): what the gateway's
+    # two-stage router reads off /v1/stats. Policy, not capability — every
+    # role keeps the full engine.
+    ap.add_argument("--role", choices=("mixed", "prefill", "decode"),
+                    default="mixed")
     ap.add_argument("--no-warmup", action="store_true")
     # Admission control: bound the pending queue (shed with 429 past it)
     # and default every request to a deadline (expired requests free their
@@ -1187,6 +1496,7 @@ def main(argv=None) -> int:
             deadline_s=args.deadline_s or None,
             slo_ttft_p95_ms=args.slo_ttft_p95_ms or None,
             slo_availability=args.slo_availability or None,
+            role=args.role,
         )
         # Warmup before the engine thread starts: step() is single-driver.
         if not args.no_warmup:

@@ -178,6 +178,19 @@ class Request:
     # admission count — terminal paths must not decrement it again.
     preemptions: int = 0
     requeued: bool = False
+    # Disaggregated serving (KV handoff). ``export=True`` runs prefill ONLY:
+    # no slot is seated, no pages are allocated — the dense prefill KV block
+    # is fetched to host (through the counted ``_fetch`` seam) and handed
+    # back on ``export_payload`` with the first sampled token; a decode cell
+    # imports it and continues generation without re-running prefill.
+    export: bool = False
+    export_payload: "dict | None" = None
+    # Import side: {"token", "length", "k", "v"} — host numpy KV rows
+    # [L, 1, length, KV, D] from a prefill cell's export. The request seats
+    # directly into a decode slot (``insert_paged``/``insert`` scatter the
+    # block home); if it is later preempted, ``generated`` is non-empty and
+    # the resume path re-prefills locally like any preempted request.
+    kv_import: "dict | None" = None
 
     def cancel(self) -> None:
         """Ask the engine to stop generating for this request. Thread-safe:
@@ -1154,6 +1167,8 @@ class ServingEngine:
         prefix_id: str | None = None,
         deadline_s: float | None = None,
         trace_ctx: "Any | None" = None,
+        export: bool = False,
+        kv_import: "dict | None" = None,
     ) -> Request:
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
@@ -1162,7 +1177,14 @@ class ServingEngine:
             raise ValueError(
                 f"prompt length {prompt.size} >= engine max_seq_len {self.max_seq_len}"
             )
-        if self.paged:
+        if export and kv_import is not None:
+            raise ValueError("a request cannot both export and import KV")
+        if kv_import is not None and int(kv_import["length"]) != prompt.size:
+            raise ValueError(
+                f"kv_import length {kv_import['length']} != prompt length "
+                f"{prompt.size} — the imported block must cover exactly the "
+                "prompt rows")
+        if self.paged and not export:
             need = self._pool.pages_for(int(prompt.size) + 1)
             if need > self._pool.num_pages:
                 # Even an empty pool could never hold this prompt: fail at
@@ -1188,6 +1210,7 @@ class ServingEngine:
                     prefix_id=prefix_id,
                     deadline=(now + deadline_s)
                     if deadline_s is not None else None,
+                    export=export, kv_import=kv_import,
                 )
                 self._next_id += 1
                 self._requests[req.id] = req
@@ -1572,7 +1595,9 @@ class ServingEngine:
         self._ensure_loaded()
         did_work = self._sweep_cancelled()
         prefills = []
-        for slot in self._free_slots():
+        exports = []
+        free = list(self._free_slots())
+        while free:
             req, resumed, swept = self._pop_waiting()
             did_work = did_work or swept
             if req is None:
@@ -1584,8 +1609,24 @@ class ServingEngine:
                     time.monotonic() - req.submitted_at)
             if req.trace is not None:
                 req.trace.event("admitted")
+            if req.export:
+                # Prefill-only (KV handoff export): no slot, no pages —
+                # the loop's free list is untouched, so a prefill cell
+                # drains export bursts without decode-slot contention.
+                try:
+                    exports.append(self._dispatch_prefill_export(req))
+                except Exception as e:
+                    self._fail_request(req, e)
+                    raise
+                did_work = True
+                continue
+            slot = free.pop(0)
             try:
-                prefills.append(self._dispatch_prefill(req, slot))
+                got = self._dispatch_prefill(req, slot)
+                # Import seats emit host-side (the first token came with
+                # the block) and return None — nothing to fetch later.
+                if got is not None:
+                    prefills.append(got)
             except PagePoolExhausted as e:
                 # No pages for this prompt right now. If anything is in
                 # flight, pages WILL free (requests finish, preemption,
@@ -1610,18 +1651,34 @@ class ServingEngine:
             did_work = True
 
         new_inflight = None
-        if self._active_requests():
-            new_inflight = self._dispatch_decode_chunk()
-            did_work = True
+        try:
+            if self._active_requests():
+                new_inflight = self._dispatch_decode_chunk()
+                did_work = True
 
-        if prefills:
-            # One stacked fetch for every prefill's first token (per-request
-            # int() would pay one link round-trip each); the decode chunk
-            # dispatched above is already running behind it on the device.
-            with set_mesh(self.mesh):
-                firsts = self._fetch(jnp.stack([f for _, f in prefills]))
-            for (req, _), first in zip(prefills, firsts):
-                self._emit(req, int(first))
+            if prefills:
+                # One stacked fetch for every prefill's first token
+                # (per-request int() would pay one link round-trip each);
+                # the decode chunk dispatched above is already running
+                # behind it on the device.
+                with set_mesh(self.mesh):
+                    firsts = self._fetch(jnp.stack([f for _, f in prefills]))
+                for (req, _), first in zip(prefills, firsts):
+                    self._emit(req, int(first))
+        except Exception as e:
+            # Dispatched-but-unfetched exports hold no slot and sit in no
+            # queue, so _fail_all cannot find them — fail them HERE or
+            # their waiters hang when this exception unwinds the step.
+            for exp in exports:
+                self._fail_request(exp[0], e)
+            raise
+
+        for exp in exports:
+            # Export readbacks happen after the decode dispatch for the
+            # same reason as the prefill fetch above: the host-bounce DMA
+            # overlaps the chunk already running on the device.
+            self._finish_export(*exp)
+            did_work = True
 
         if self._inflight is not None:
             self._flush_inflight()
@@ -1860,6 +1917,13 @@ class ServingEngine:
         the model (an agent session's shared context prefills once); the
         resulting prompt KV is (re)stored under the request's prefix_id
         either way."""
+        if req.kv_import is not None and not req.generated:
+            # KV handoff import: the prompt's KV arrived from a prefill
+            # cell — seat it directly, never re-run prefill. A preempted
+            # import re-enters with ``generated`` non-empty and takes the
+            # normal re-prefill path below (its imported block is stale by
+            # then; local prefill of prompt+generated rebuilds it).
+            return self._dispatch_import(req, slot)
         if self.paged:
             return self._dispatch_prefill_paged(req, slot)
         faults.maybe_fail("engine.prefill")
@@ -1905,6 +1969,165 @@ class ServingEngine:
         self._slot_len[slot] = n + 1   # prompt + the first generated token's kv-to-be
         self._sampling_dirty = True
         return req, first
+
+    # --- disaggregated serving: KV handoff export / import -----------------
+
+    def _dispatch_prefill_export(self, req: Request):
+        """Prefill-only dispatch for a KV handoff export (disaggregated
+        serving): run the prefill program, never seat a slot or touch the
+        page pool — the caller fetches the dense KV block to host in
+        :meth:`_finish_export`. Works in both layouts (the cold prefill
+        program exists regardless of paging); on a legacy engine the
+        prefix cache still participates, so N agent sessions exporting one
+        shared context prefill only its suffix."""
+        faults.maybe_fail("engine.prefill")
+        t0 = time.monotonic()
+        n = int(req.prompt.size)
+        sp = req.sampling
+        cached = None if self.paged else self._prefix_lookup(req)
+        with set_mesh(self.mesh):
+            self._key, k1 = jax.random.split(self._key)
+            if cached is not None:
+                self.prefix_hits += 1
+                tail = req.prompt[cached.length:]
+                bucket = min(self._bucket(tail.size), self.max_seq_len)
+                tokens = np.zeros((1, bucket), np.int32)
+                tokens[0, : tail.size] = tail
+                first, kv_k, kv_v = self._prefill_ext(
+                    self.params, cached.kv_k, cached.kv_v, cached.length,
+                    self._upload(tokens), tail.size, k1,
+                    jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                    jnp.float32(sp.top_p),
+                )
+            else:
+                if req.prefix_id is not None and not self.paged:
+                    self.prefix_misses += 1
+                bucket = min(self._bucket(n), self.max_seq_len)
+                tokens = np.zeros((1, bucket), np.int32)
+                tokens[0, :n] = req.prompt
+                first, kv_k, kv_v = self._prefill(
+                    self.params, self._upload(tokens), n, k1,
+                    jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                    jnp.float32(sp.top_p),
+                )
+            if req.prefix_id is not None and not self.paged:
+                self._prefix_store(req.prefix_id, req.prompt, kv_k, kv_v)
+        self._m_prefill.observe(time.monotonic() - t0, bucket=str(bucket))
+        if req.trace is not None:
+            req.trace.event("prefill_dispatched")
+        return req, first, kv_k, kv_v, n
+
+    def _finish_export(self, req: Request, first_dev, kv_k, kv_v, n: int):
+        """Fetch an export's first token + prompt KV rows to host — both
+        through the counted ``_fetch`` seam, so the handoff's transfer cost
+        is visible in ``sync_stats`` and on /metrics — and complete the
+        request with the payload the serving cell serializes over
+        ``/v1/kv/export``."""
+        try:
+            with set_mesh(self.mesh):
+                first = int(self._fetch(first_dev))
+                k_host = self._fetch(kv_k[:, :, :n])
+                v_host = self._fetch(kv_v[:, :, :n])
+        except Exception as e:  # noqa: BLE001 — fail THIS request, keep serving
+            self._fail_request(req, e)
+            return
+        req.export_payload = {
+            "token": first, "length": n, "k": k_host, "v": v_host,
+            "pageTokens": self.page_tokens,
+        }
+        if req.trace is not None:
+            req.trace.event("kv_exported",
+                            bytes=int(k_host.nbytes + v_host.nbytes))
+        with self._lock:
+            self._requests.pop(req.id, None)
+        self._observe_terminal(req, "ok")
+        if req.emit:
+            try:
+                req.emit(first, True)
+            except Exception:  # noqa: BLE001 — a bad sink must not kill the driver
+                pass
+        req.done.set()
+
+    def _dispatch_import(self, req: Request, slot: int):
+        """Seat a KV-handoff import directly into a decode slot: upload the
+        prefill cell's block through the counted ``_upload`` seam, scatter
+        it home with the existing ``insert_paged`` program (page-granular
+        alloc, scratch-padded ids — one compile per bucket, shared with the
+        local prefill path) or ``insert`` on the legacy layout, then emit
+        the imported first token through the normal machinery. Prefill
+        never re-runs here — that is the point of the handoff.
+
+        ``PagePoolExhausted`` propagates to step()'s admission handler, so
+        an import under pool pressure parks for resume (or sheds 429 when
+        idle) exactly like a local prefill."""
+        faults.maybe_fail("engine.prefill")
+        imp = req.kv_import
+        n = int(imp["length"])
+        first = int(imp["token"])
+        k_np, v_np = imp["k"], imp["v"]
+        bucket = min(self._bucket(n), self.max_seq_len)
+        want = np.dtype(self.cfg.dtype)
+
+        def to_bucket(block):
+            """Pad/trim the exporter's [L, 1, n, KV, D] rows to THIS
+            engine's bucket shape and cache dtype (the two cells may run
+            different bucket ladders or dtypes)."""
+            out = block
+            if out.dtype != want:
+                out = out.astype(want)
+            if out.shape[2] != bucket:
+                padded = np.zeros(
+                    (out.shape[0], 1, bucket) + out.shape[3:], dtype=want)
+                rows = min(n, bucket)
+                padded[:, :, :rows] = out[:, :, :rows]
+                out = padded
+            return out
+
+        if self.paged:
+            pt = self.page_tokens
+            n_total = n // pt + 1      # pages covering positions [0, n]
+            try:
+                pages = self._pool.alloc(n_total)
+            except PagePoolExhausted:
+                if not self._reclaim_prefix_pages(n_total):
+                    raise
+                pages = self._pool.alloc(n_total)
+            with set_mesh(self.mesh):
+                ids = np.full((bucket // pt,), SCRATCH_PAGE, np.int32)
+                prompt_pages = -(-n // pt)   # ceil: pages holding KV rows
+                ids[:prompt_pages] = pages[:prompt_pages]
+                self.state = self._insert_paged(
+                    self.state, self._upload(to_bucket(k_np)),
+                    self._upload(to_bucket(v_np)), n,
+                    self._upload(ids), slot, jnp.int32(first))
+            self._slot_pages[slot] = pages
+            self._bt[slot, :] = SCRATCH_PAGE
+            self._bt[slot, : len(pages)] = pages
+            self._bt_dirty = True
+            self._slot_disp[slot] = n
+        else:
+            with set_mesh(self.mesh):
+                self.state = self._insert(
+                    self.state, self._upload(to_bucket(k_np)),
+                    self._upload(to_bucket(v_np)), n, slot,
+                    jnp.int32(first))
+        req.slot = slot
+        self._slot_req[slot] = req
+        self._slot_len[slot] = n + 1
+        self._sampling_dirty = True
+        if req.trace is not None:
+            req.trace.event("kv_imported",
+                            bytes=int(k_np.nbytes + v_np.nbytes),
+                            pages=(len(self._slot_pages[slot])
+                                   if self.paged else 0))
+        # The imported first token flows through the normal emit machinery:
+        # TTFT on this engine measures submit -> seated (the import cost),
+        # and the finished checks (eos / stop tokens / max_new_tokens /
+        # context cap) behave exactly as if this engine had produced the
+        # token itself — including an immediate release when it is
+        # terminal.
+        self._emit(req, first)
+        return None
 
     def _chunk_size(self) -> int:
         """Largest safe K, bounded by decode_chunk and cache capacity.

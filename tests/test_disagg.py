@@ -1,0 +1,447 @@
+"""Disaggregated prefill/decode serving (ISSUE 11): two-stage routing,
+page-granular KV export→import parity, one-trace handoff observability,
+and graceful degradation to local decode when the decode pool fails.
+
+Replica failure is always *scripted* (server shutdown, armed fault point,
+stale routing snapshots), never timed — same philosophy as the gateway and
+resilience suites. Every cell here is a REAL ServingCell over real HTTP;
+the tiny model keeps it CPU-cheap.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from http.server import ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from kukeon_tpu import faults
+from kukeon_tpu.gateway.cell import GatewayCell, make_gateway_handler
+from kukeon_tpu.gateway.router import (
+    POLICY_AFFINITY,
+    POLICY_PREFILL_QUEUE,
+    Router,
+)
+from kukeon_tpu.runtime.serving_cell import (
+    ServingCell,
+    make_handler,
+    pack_kv,
+    unpack_kv,
+)
+
+
+# --- router two-stage units --------------------------------------------------
+
+
+def _static_router(roles: list[str]) -> Router:
+    r = Router([(f"r{i}", f"http://127.0.0.1:{21000 + i}")
+                for i in range(len(roles))])
+    for rep, role in zip(r.replicas, roles):
+        rep.role = role
+        rep.ready = True
+    return r
+
+
+def test_router_mixed_census_is_not_disaggregated():
+    r = _static_router(["mixed", "mixed", "mixed"])
+    assert not r.disaggregated()
+    # pick() with no pool is the pre-role behavior: full set.
+    rep, _ = r.pick()
+    assert rep is not None
+
+
+def test_router_pick_prefill_by_queue_depth():
+    r = _static_router(["prefill", "prefill", "decode"])
+    assert r.disaggregated()
+    r.by_name["r0"].queue_depth = 5
+    r.by_name["r1"].queue_depth = 1
+    rep, policy = r.pick_prefill()
+    assert rep.name == "r1"
+    assert policy == POLICY_PREFILL_QUEUE
+    # The decode-only replica is never a prefill candidate, even when
+    # everything prefill-capable is excluded.
+    rep, policy = r.pick_prefill(exclude={"r0", "r1"})
+    assert rep is None and policy is None
+
+
+def test_router_pick_decode_affinity_and_fallback():
+    r = _static_router(["prefill", "decode", "decode"])
+    # Rendezvous over the decode pool only: a prefix maps to one decode
+    # replica, stably.
+    affine = r.affine("sess-42", pool="decode")
+    assert affine.name in ("r1", "r2")
+    rep, policy = r.pick_decode("sess-42")
+    assert rep.name == affine.name
+    assert policy == POLICY_AFFINITY
+    # Affine replica down -> least-loaded decode-capable fallback; the
+    # prefill replica is never eligible.
+    affine.ready = False
+    rep, _policy = r.pick_decode("sess-42")
+    assert rep is not None and rep.name != affine.name
+    assert rep.decode_capable()
+
+
+def test_router_pool_filter_on_pick():
+    r = _static_router(["prefill", "decode"])
+    rep, _ = r.pick(pool="prefill")
+    assert rep.name == "r0"
+    rep, _ = r.pick(pool="decode")
+    assert rep.name == "r1"
+
+
+# --- real-cell stack helpers -------------------------------------------------
+
+
+def _make_cell(role: str, **kw) -> tuple[ServingCell, ThreadingHTTPServer]:
+    cell = ServingCell("tiny", num_slots=2, max_seq_len=128,
+                       checkpoint=None, dtype=None, kv_page_tokens=16,
+                       max_pending=256, role=role, **kw)
+    cell.engine.start()
+    cell.mark_ready()
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(cell))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return cell, srv
+
+
+def _make_stack(roles=("prefill", "decode"), poll_interval_s=0.05):
+    cells, servers, urls = [], [], []
+    for role in roles:
+        cell, srv = _make_cell(role)
+        cells.append(cell)
+        servers.append(srv)
+        urls.append(f"http://127.0.0.1:{srv.server_address[1]}")
+    gw = GatewayCell("tiny", urls, poll_interval_s=poll_interval_s,
+                     request_timeout_s=60.0)
+    gw.start()
+    gw.router.poll_once()
+    gw_srv = ThreadingHTTPServer(("127.0.0.1", 0), make_gateway_handler(gw))
+    threading.Thread(target=gw_srv.serve_forever, daemon=True).start()
+    return cells, servers, gw, gw_srv
+
+
+def _teardown(cells, servers, gw, gw_srv):
+    gw_srv.shutdown()
+    gw_srv.server_close()
+    gw.stop()
+    for srv in servers:
+        try:
+            srv.shutdown()
+            srv.server_close()
+        except OSError:
+            pass
+    for cell in cells:
+        cell.engine.stop()
+
+
+def _post(port: int, path: str, body, timeout: float = 60.0,
+          headers: dict | None = None, raw: bool = False):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    payload = body if isinstance(body, (bytes, bytearray)) else \
+        json.dumps(body)
+    conn.request("POST", path, body=payload,
+                 headers={"Content-Type": "application/json",
+                          **(headers or {})})
+    resp = conn.getresponse()
+    data = resp.read()
+    status = resp.status
+    conn.close()
+    if raw:
+        return status, data
+    return status, (json.loads(data) if data else {})
+
+
+# --- role census -------------------------------------------------------------
+
+
+def test_role_census_in_stats_and_gateway_snapshot():
+    cells, servers, gw, gw_srv = _make_stack(("prefill", "decode"))
+    try:
+        assert cells[0].stats()["role"] == "prefill"
+        assert cells[1].stats()["role"] == "decode"
+        # The gateway learned both roles from its poll and reports them in
+        # its own stats (the fleet's routing view).
+        snap = {r["name"]: r["role"]
+                for r in gw.stats()["replicas"]}
+        assert snap == {"r0": "prefill", "r1": "decode"}
+        assert gw.router.disaggregated()
+    finally:
+        _teardown(cells, servers, gw, gw_srv)
+
+
+# --- export -> import parity -------------------------------------------------
+
+
+def test_paged_export_import_roundtrip_greedy_parity():
+    """A handed-off request decodes byte-identically to a single-cell one:
+    export on engine A, import on paged engine B, greedy tokens equal the
+    single-engine reference."""
+    import jax
+
+    from kukeon_tpu.models import llama
+    from kukeon_tpu.parallel import auto_mesh_shape, make_mesh
+    from kukeon_tpu.serving import SamplingParams, ServingEngine
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(jax.random.key(0), cfg)
+    shape = auto_mesh_shape(len(jax.devices()))
+    mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
+    sp = SamplingParams(max_new_tokens=8)
+    prompt = np.arange(1, 24, dtype=np.int32)
+
+    def paged_engine():
+        return ServingEngine(cfg, params, mesh, num_slots=2,
+                             max_seq_len=128, kv_page_tokens=16)
+
+    ref_eng = paged_engine()
+    ref = ref_eng.generate(prompt, sp)
+    assert len(ref) == 8
+
+    exporter = paged_engine()
+    r = exporter.submit(prompt, sp, export=True)
+    while not r.done.is_set():
+        exporter.step()
+    p = r.export_payload
+    assert p["token"] == ref[0]
+    assert p["length"] == prompt.size
+    assert p["k"].shape[2] == prompt.size     # trimmed to real rows
+    # No slot, no pages: the exporter's pool is untouched.
+    assert exporter._pool.in_use == 0
+    assert all(s is None for s in exporter._slot_req)
+
+    importer = paged_engine()
+    r2 = importer.submit(prompt, sp, kv_import={
+        "token": p["token"], "length": p["length"],
+        "k": p["k"], "v": p["v"]})
+    while not r2.done.is_set():
+        importer.step()
+    assert r2.error is None
+    assert r2.generated == ref
+    # Pages were allocated and freed page-granularly.
+    assert importer._pool.in_use == 0
+
+    # The legacy contiguous layout imports the same block identically.
+    legacy = ServingEngine(cfg, params, mesh, num_slots=2, max_seq_len=128,
+                           kv_page_tokens=0)
+    r3 = legacy.submit(prompt, sp, kv_import={
+        "token": p["token"], "length": p["length"],
+        "k": p["k"], "v": p["v"]})
+    while not r3.done.is_set():
+        legacy.step()
+    assert r3.generated == ref
+
+
+def test_kv_wire_format_roundtrip():
+    k = np.arange(24, dtype=np.float32).reshape(2, 1, 3, 2, 2)
+    v = k + 100
+    body = pack_kv({"token": 7, "length": 3}, k, v)
+    header, k2, v2 = unpack_kv(body)
+    assert header["token"] == 7
+    assert header["shape"] == [2, 1, 3, 2, 2]
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
+    with pytest.raises(ValueError, match="truncated"):
+        unpack_kv(body[:-4])
+
+
+# --- disaggregated e2e: one trace, two hops ---------------------------------
+
+
+def test_disagg_e2e_one_trace_with_both_hops():
+    cells, servers, gw, gw_srv = _make_stack(("prefill", "decode"))
+    try:
+        ref = cells[1].generate({"promptTokens": list(range(1, 20)),
+                                 "maxNewTokens": 6})
+        status, out = _post(gw_srv.server_address[1], "/v1/generate",
+                            {"promptTokens": list(range(1, 20)),
+                             "maxNewTokens": 6, "prefixId": "sess-1"})
+        assert status == 200
+        # The handed-off request decodes exactly like the single cell.
+        assert out["tokens"] == ref["tokens"]
+
+        # ONE trace: the gateway span is the root; the prefill cell's and
+        # decode cell's engine spans are its children.
+        gspan = next(s for s in gw.tracer.recent(10)
+                     if s["component"] == "gateway"
+                     and s.get("attrs", {}).get("route") == "/v1/generate")
+        trace_id = gspan["traceId"]
+        # The decode engine's tracer.finish runs on the driver thread just
+        # after the terminal token is emitted — poll briefly rather than
+        # racing it.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            pspans = cells[0].engine.tracer.for_trace(trace_id)
+            dspans = cells[1].engine.tracer.for_trace(trace_id)
+            if pspans and dspans:
+                break
+            time.sleep(0.01)
+        assert len(pspans) == 1 and len(dspans) == 1
+        for espan in (pspans[0], dspans[0]):
+            assert espan["parentSpanId"] == gspan["spanId"]
+            # Engine phases partition the hop's wall time exactly.
+            assert abs(sum(espan["phasesS"].values())
+                       - espan["e2eS"]) < 1e-3
+        # The hops are recognizably the two halves of the handoff.
+        assert any(e["event"] == "kv_exported"
+                   for e in pspans[0]["events"])
+        assert any(e["event"] == "kv_imported"
+                   for e in dspans[0]["events"])
+        # The gateway span records the handoff itself, and `kuke trace`
+        # renders the hop.
+        hand = next(e for e in gspan["events"]
+                    if e["event"] == "kv_handoff")
+        assert hand["attrs"]["prefill"] == "r0"
+        assert hand["attrs"]["decode"] == "r1"
+        assert hand["attrs"]["pages"] >= 1
+
+        from kukeon_tpu.runtime.cli import render_trace
+
+        rendered = render_trace(
+            trace_id, [gspan, pspans[0], dspans[0]])
+        assert "handoff r0->r1" in rendered
+
+        # The handoff cost is on the gateway's own instruments.
+        assert gw.registry.get("kukeon_handoff_pages_total").value() >= 1
+        assert gw.registry.get("kukeon_handoff_bytes_total").value() > 0
+        assert sum(gw.registry.get(
+            "kukeon_handoff_seconds").snapshot()[0]) >= 1
+    finally:
+        _teardown(cells, servers, gw, gw_srv)
+
+
+def test_disagg_streaming_preserves_tokens_and_text():
+    cells, servers, gw, gw_srv = _make_stack(("prefill", "decode"))
+    try:
+        ref = cells[1].generate({"prompt": "hello world",
+                                 "maxNewTokens": 6})
+        status, data = _post(gw_srv.server_address[1], "/v1/generate",
+                             {"prompt": "hello world", "maxNewTokens": 6,
+                              "stream": True}, raw=True)
+        assert status == 200
+        lines = [json.loads(ln) for ln in data.splitlines()]
+        toks = [ln["token"] for ln in lines if "token" in ln]
+        assert toks == ref["tokens"]
+        text = "".join(ln.get("text", "") for ln in lines if "token" in ln)
+        assert text == ref["text"]
+        assert lines[-1]["done"] is True
+    finally:
+        _teardown(cells, servers, gw, gw_srv)
+
+
+def test_mixed_roles_still_route_single_hop():
+    """An all-mixed census must keep today's single-hop path: no handoff
+    counters move, requests flow exactly as before roles existed."""
+    cells, servers, gw, gw_srv = _make_stack(("mixed", "mixed"))
+    try:
+        assert not gw.router.disaggregated()
+        status, out = _post(gw_srv.server_address[1], "/v1/generate",
+                            {"promptTokens": [1, 2, 3], "maxNewTokens": 4})
+        assert status == 200
+        assert len(out["tokens"]) == 4
+        assert gw.registry.get("kukeon_handoff_pages_total").value() == 0
+        assert sum(gw.registry.get(
+            "kukeon_handoff_seconds").snapshot()[0]) == 0
+    finally:
+        _teardown(cells, servers, gw, gw_srv)
+
+
+# --- robustness: kv.handoff fault + decode-pool death ------------------------
+
+
+def test_kv_handoff_fault_falls_back_to_local_decode(monkeypatch):
+    """The armed ``kv.handoff`` fault kills the first import; the gateway
+    counts the failure and degrades that request to local decode on the
+    prefill-capable replica — the client still gets its 200."""
+    cells, servers, gw, gw_srv = _make_stack(("prefill", "decode"))
+    try:
+        monkeypatch.setenv("KUKEON_FAULTS", "kv.handoff:1:1")
+        faults.reset()
+        status, out = _post(gw_srv.server_address[1], "/v1/generate",
+                            {"promptTokens": list(range(1, 10)),
+                             "maxNewTokens": 4})
+        assert status == 200
+        assert len(out["tokens"]) == 4
+        assert faults.fired("kv.handoff") == 1
+        assert gw.registry.get("kukeon_handoff_failures_total").value(
+            stage="import") == 1
+        assert gw.registry.get("kukeon_handoff_fallback_total").value() == 1
+        # The fault is exhausted: the next request handoffs normally.
+        status, out = _post(gw_srv.server_address[1], "/v1/generate",
+                            {"promptTokens": list(range(1, 10)),
+                             "maxNewTokens": 4})
+        assert status == 200
+        assert gw.registry.get("kukeon_handoff_pages_total").value() >= 1
+    finally:
+        _teardown(cells, servers, gw, gw_srv)
+
+
+def test_decode_replica_death_mid_handoff_only_200_or_429():
+    """Kill the decode replica mid-flood: the router's snapshot still says
+    ready (slow poll), so imports dial a dead socket — every affected
+    request must degrade to local decode (200) or shed (429); a 5xx is a
+    failure of the degradation contract."""
+    cells, servers, gw, gw_srv = _make_stack(("prefill", "decode"),
+                                             poll_interval_s=30.0)
+    try:
+        # Warm one full handoff so the import path is proven live first.
+        status, _ = _post(gw_srv.server_address[1], "/v1/generate",
+                          {"promptTokens": list(range(1, 10)),
+                           "maxNewTokens": 3})
+        assert status == 200
+
+        # The decode replica dies. The stale routing snapshot still lists
+        # it ready — the next imports hit a refused connection.
+        servers[1].shutdown()
+        servers[1].server_close()
+        cells[1].engine.stop()
+
+        statuses: dict[int, int] = {}
+        lock = threading.Lock()
+
+        def one(i: int) -> None:
+            s, _ = _post(gw_srv.server_address[1], "/v1/generate",
+                         {"promptTokens": list(range(1, 10 + i)),
+                          "maxNewTokens": 3}, timeout=60.0)
+            with lock:
+                statuses[s] = statuses.get(s, 0) + 1
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(6)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+        assert set(statuses) <= {200, 429}, statuses
+        assert statuses.get(200, 0) >= 1
+        assert gw.registry.get("kukeon_handoff_fallback_total").value() >= 1
+        assert gw.registry.get("kukeon_handoff_failures_total").value(
+            stage="import") >= 1
+    finally:
+        _teardown(cells, servers, gw, gw_srv)
+
+
+def test_import_sheds_429_when_decode_queue_full():
+    """An import landing on a saturated decode engine sheds with the same
+    429 + Retry-After contract as /v1/generate — the gateway (and any
+    client) needs no new failure vocabulary."""
+    cell, srv = _make_cell("decode")
+    try:
+        eng = cell.engine
+        # Saturate: stop the engine loop so nothing drains, fill pending.
+        eng.stop()
+        eng.max_pending = 1
+        eng.submit(np.asarray([1, 2, 3], np.int32))
+        body = pack_kv({"token": 5, "length": 3,
+                        "promptTokens": [1, 2, 3], "maxNewTokens": 4},
+                       np.zeros((2, 1, 3, 2, 32), np.float32),
+                       np.zeros((2, 1, 3, 2, 32), np.float32))
+        status, out = _post(srv.server_address[1], "/v1/kv/import", body)
+        assert status == 429
+        assert "error" in out
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        cell.engine.stop()

@@ -679,6 +679,34 @@ def test_runner_materializes_replicas_and_gateway(replicated_ctl):
     assert rec.status.tpu_chips == [0, 1]
 
 
+def test_runner_materializes_disagg_roles(replicated_ctl):
+    """`role: "prefill,decode"` assigns one role atom per replica in
+    declaration order (the same order the base-port scheme assigns ports);
+    the gateway container gets NO role flags — it discovers pools from
+    each cell's /v1/stats census."""
+    ctl, backend, _store, _devices = replicated_ctl
+    doc = t.Document(
+        kind=t.KIND_CELL, metadata=t.Metadata(name="llm"),
+        spec=t.CellSpec(model=t.ModelSpec(model="tiny", chips=1,
+                                          replicas=2, port=9300,
+                                          role="prefill,decode")),
+    )
+    ctl.create_cell(doc)
+    started = {c.spec.name: c for c in backend.started}
+    cmd0 = started["model-server-0"].command
+    cmd1 = started["model-server-1"].command
+    assert cmd0[cmd0.index("--role") + 1] == "prefill"
+    assert cmd1[cmd1.index("--role") + 1] == "decode"
+    assert "--role" not in started["gateway"].command
+    # The mixed default stays flag-free: byte-identical to before roles.
+    from kukeon_tpu.runtime.api.types import ModelSpec
+    from kukeon_tpu.runtime.runner import Runner  # noqa: F401 — ctl.runner
+
+    for c in ctl.runner._model_containers(
+            ModelSpec(model="tiny", chips=1, replicas=2, port=9400)):
+        assert "--role" not in c.command
+
+
 def test_rolling_restart_under_flood_zero_failures(replicated_ctl,
                                                    monkeypatch):
     """Acceptance + satellite: flood the gateway while RolloutCell rolls
@@ -929,42 +957,67 @@ def _load_bench():
     return mod
 
 
-def test_bench_artifact_v3_and_backcompat(tmp_path):
+def test_bench_artifact_v4_and_backcompat(tmp_path):
     bench = _load_bench()
     serve = {"backend": "cpu", "n_chips": 1, "model": "tiny",
              "model_id": "tiny", "sessions": 4, "tok_per_s": 100.0,
              "trials": [100.0], "replicas": 3,
-             "kv_page_tokens": 16, "max_sessions": 9}
+             "kv_page_tokens": 16, "max_sessions": 9,
+             "ttft_p95_s": 0.25}
     out = tmp_path / "BENCH_rXX.json"
-    bench.write_artifact(str(out), serve, {"vs_baseline": 0.5})
+    bench.write_artifact(str(out), serve,
+                         {"vs_baseline": 0.5, "handoff_ms_p50": 12.5,
+                          "disagg": {"arms": {}}})
     art = bench.read_artifact(str(out))
-    assert art["schema"] == "kukeon-bench/v3"
+    assert art["schema"] == "kukeon-bench/v4"
     assert art["replicas"] == 3
     assert art["kv_page_tokens"] == 16
     assert art["max_sessions"] == 9
+    assert art["ttft_p95_s"] == 0.25
+    assert art["handoff_ms_p50"] == 12.5
+    assert art["disagg"] == {"arms": {}}
 
-    # A v1 point (pre-gateway, single engine) reads back as v3: replicas=1,
-    # legacy contiguous KV (kv_page_tokens=0), every session resident.
+    # A v1 point (pre-gateway, single engine) reads back as v4: replicas=1,
+    # legacy contiguous KV (kv_page_tokens=0), every session resident, no
+    # handoff (none existed).
     v1 = tmp_path / "BENCH_r05.json"
     v1.write_text(json.dumps({"schema": "kukeon-bench/v1", "backend": "cpu",
                               "tok_per_s": 50.0, "sessions": 4}))
     art = bench.read_artifact(str(v1))
-    assert art["schema"] == "kukeon-bench/v3"
+    assert art["schema"] == "kukeon-bench/v4"
     assert art["replicas"] == 1
     assert art["tok_per_s"] == 50.0
     assert art["kv_page_tokens"] == 0
     assert art["max_sessions"] == 4
+    assert art["ttft_p95_s"] is None
+    assert art["handoff_ms_p50"] is None
+    assert art["disagg"] is None
 
-    # A v2 point (pre-paged-KV) keeps its replicas and gains the v3 fields.
+    # A v2 point (pre-paged-KV) keeps its replicas and gains the later
+    # fields; its TTFT p95 lifts from the latency percentiles it recorded.
     v2 = tmp_path / "BENCH_r06.json"
     v2.write_text(json.dumps({"schema": "kukeon-bench/v2", "backend": "cpu",
                               "tok_per_s": 60.0, "sessions": 2,
-                              "replicas": 2}))
+                              "replicas": 2,
+                              "latency_s": {"ttft": {"p95": 0.4}}}))
     art = bench.read_artifact(str(v2))
-    assert art["schema"] == "kukeon-bench/v3"
+    assert art["schema"] == "kukeon-bench/v4"
     assert art["replicas"] == 2
     assert art["kv_page_tokens"] == 0
     assert art["max_sessions"] == 2
+    assert art["ttft_p95_s"] == 0.4
+
+    # A v3 point (pre-disaggregation) gains only the v4 fields.
+    v3 = tmp_path / "BENCH_r07.json"
+    v3.write_text(json.dumps({"schema": "kukeon-bench/v3", "backend": "cpu",
+                              "tok_per_s": 70.0, "sessions": 2,
+                              "replicas": 1, "kv_page_tokens": 16,
+                              "max_sessions": 4}))
+    art = bench.read_artifact(str(v3))
+    assert art["schema"] == "kukeon-bench/v4"
+    assert art["kv_page_tokens"] == 16
+    assert art["max_sessions"] == 4
+    assert art["handoff_ms_p50"] is None
 
     bad = tmp_path / "BENCH_bad.json"
     bad.write_text(json.dumps({"schema": "nope/v9"}))

@@ -599,6 +599,66 @@ def test_kuke011_silent_without_an_alerts_module(tmp_path):
     assert run_analysis(pkg, select=["KUKE011"]) == []
 
 
+# --- KUKE012: KV handoff transfer discipline ---------------------------------
+
+
+def test_kuke012_flags_raw_transfers_in_handoff_code(tmp_path):
+    pkg = _engine_repo(tmp_path, '''
+        def _finish_export(self, kv):
+            block = self._insert(self.state, kv, 4, 0, 1)
+            host = np.asarray(block)            # raw readback of KV bytes
+            jax.device_get(block)
+            return host
+
+        def _dispatch_import(self, block):
+            up = jnp.asarray(block)             # raw upload of KV bytes
+            dev = jax.device_put(block)
+            return up, dev
+
+        def step(self):
+            # Not handoff-named: KUKE012 stays out (KUKE001/002's scope).
+            return jax.device_put([1])
+    ''')
+    found = run_analysis(pkg, select=["KUKE012"])
+    assert sorted(f.detail for f in found) == [
+        "jax.device_get", "jax.device_put", "jnp.asarray", "np.asarray"]
+    assert all(f.rule == "KUKE012" for f in found)
+    scopes = {f.scope for f in found}
+    assert scopes == {"ServingEngine._finish_export",
+                      "ServingEngine._dispatch_import"}
+
+
+def test_kuke012_silent_through_the_counted_seams(tmp_path):
+    pkg = _engine_repo(tmp_path, '''
+        def _finish_export(self, kv):
+            block = self._insert(self.state, kv, 4, 0, 1)
+            return self._fetch(block)           # the seam: counted
+
+        def _dispatch_import(self, block):
+            padded = np.zeros((2, 1, 8), np.float32)   # host work: fine
+            return self._upload(padded)         # the seam: counted
+    ''')
+    assert run_analysis(pkg, select=["KUKE012"]) == []
+
+
+def test_kuke012_covers_serving_cell_kv_helpers(tmp_path):
+    pkg = _mini_repo(tmp_path, {"runtime/serving_cell.py": '''
+        import jax
+        import numpy as np
+
+
+        def pack_kv(header, k, v):
+            return jax.device_get(k)            # handoff bytes, raw seam
+
+
+        def unrelated(x):
+            return jax.device_get(x)            # not handoff-named: silent
+    '''})
+    found = run_analysis(pkg, select=["KUKE012"])
+    assert [f.detail for f in found] == ["jax.device_get"]
+    assert found[0].scope == "pack_kv"
+
+
 # --- baseline suppression ----------------------------------------------------
 
 
@@ -679,7 +739,7 @@ def test_all_rules_are_registered():
     assert registered_rules() == (
         "KUKE001", "KUKE002", "KUKE003", "KUKE004",
         "KUKE005", "KUKE006", "KUKE007", "KUKE008", "KUKE009",
-        "KUKE010", "KUKE011",
+        "KUKE010", "KUKE011", "KUKE012",
     )
 
 

@@ -3,7 +3,7 @@
 previous round and flag regressions.
 
 The bench artifacts (`bench.py --out BENCH_rNN.json`, schema
-kukeon-bench/v1..v3) are the repo's performance trajectory; this tool is
+kukeon-bench/v1..v4) are the repo's performance trajectory; this tool is
 the cheap guard that a round did not silently give back throughput,
 latency, cold start, or HBM headroom:
 
@@ -32,12 +32,19 @@ import os
 import re
 import sys
 
-SCHEMAS = ("kukeon-bench/v1", "kukeon-bench/v2", "kukeon-bench/v3")
+SCHEMAS = ("kukeon-bench/v1", "kukeon-bench/v2", "kukeon-bench/v3",
+           "kukeon-bench/v4")
 
 # (label, path into the artifact, direction: +1 = higher is better)
 METRICS = (
     ("tok/s", ("tok_per_s",), +1),
     ("ttft p95 (s)", ("latency_s", "ttft", "p95"), -1),
+    # v4: the top-level client-observable TTFT p95 (disagg runs measure it
+    # through the gateway; classic runs lift it from latency_s) and the KV
+    # handoff cost — a regression here means the prefill->decode transfer
+    # path got slower, the disaggregation's whole budget.
+    ("ttft p95 (s, v4)", ("ttft_p95_s",), -1),
+    ("handoff p50 (ms)", ("handoff_ms_p50",), -1),
     ("e2e p95 (s)", ("latency_s", "e2e", "p95"), -1),
     ("cold start p50 (s)", ("cold_start", "p50_s"), -1),
     ("peak HBM (bytes)", ("peak_hbm_bytes",), -1),
@@ -46,7 +53,7 @@ METRICS = (
 
 def read_artifact(path: str) -> dict | None:
     """A BENCH_rNN.json if it is a bench artifact (any schema version),
-    upgraded to the v3 shape; None for the early raw-transcript rounds."""
+    upgraded to the v4 shape; None for the early raw-transcript rounds."""
     try:
         with open(path) as f:
             artifact = json.load(f)
@@ -54,12 +61,16 @@ def read_artifact(path: str) -> dict | None:
         return None
     if not isinstance(artifact, dict) or artifact.get("schema") not in SCHEMAS:
         return None
-    if artifact["schema"] != "kukeon-bench/v3":
+    if artifact["schema"] != "kukeon-bench/v4":
         artifact = dict(artifact)
         artifact.setdefault("replicas", 1)
         artifact.setdefault("kv_page_tokens", 0)
         artifact.setdefault("max_sessions", artifact.get("sessions"))
-        artifact["schema"] = "kukeon-bench/v3"
+        lat = ((artifact.get("latency_s") or {}).get("ttft") or {})
+        artifact.setdefault("ttft_p95_s", lat.get("p95"))
+        artifact.setdefault("handoff_ms_p50", None)
+        artifact.setdefault("disagg", None)
+        artifact["schema"] = "kukeon-bench/v4"
     return artifact
 
 
