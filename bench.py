@@ -751,6 +751,138 @@ def phase_gateway(args) -> None:
     print(json.dumps(result), flush=True)
 
 
+def phase_diurnal(args) -> None:
+    """Diurnal traffic ramp through the replica gateway (`--diurnal`):
+    tiny replicas behind a GatewayCell, driven by an open-loop arrival
+    schedule that triples from night to peak and falls past the trough.
+    The replicas are deliberately sized so the peak overruns their
+    admission queues — the measurement is the gateway's SPILLOVER
+    contract (an all-shed storm becomes client latency, never a
+    client-visible 429) plus per-stage achieved throughput and client-
+    side p95, the workload shape the FleetScaler's reconcile loop is
+    built for (kukeon-bench/v5 `diurnal` section)."""
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from kukeon_tpu.gateway.cell import GatewayCell, make_gateway_handler
+    from kukeon_tpu.runtime.serving_cell import ServingCell, make_handler
+
+    n = max(2, args.replicas)
+    backend = jax.default_backend()
+    stage_s = float(os.environ.get("KUKEON_BENCH_DIURNAL_STAGE_S", "5"))
+    _log(f"diurnal: {n} tiny replicas, {stage_s:.0f}s stages [{backend}]")
+    cells, servers, urls = [], [], []
+    for _i in range(n):
+        # Small slots + shallow admission queue: the peak stage must be
+        # able to shed, or the spillover path under test never runs.
+        cell = ServingCell("tiny", num_slots=2, max_seq_len=128,
+                           checkpoint=None, dtype=None, max_pending=4)
+        cell.engine.start()
+        cell.mark_ready()
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(cell))
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        cells.append(cell)
+        servers.append(srv)
+        urls.append(f"http://127.0.0.1:{srv.server_address[1]}")
+    gw = GatewayCell("tiny", urls, poll_interval_s=0.1,
+                     spill_max_wait_s=30.0)
+    gw.start()
+    gw_srv = ThreadingHTTPServer(("127.0.0.1", 0), make_gateway_handler(gw))
+    threading.Thread(target=gw_srv.serve_forever, daemon=True).start()
+    gw.router.poll_once()
+    gport = gw_srv.server_address[1]
+
+    stages = (("night", 4.0), ("peak", 12.0), ("trough", 2.0))   # req/s
+    tokens = [0]
+    lock = threading.Lock()
+    t_run0 = time.monotonic()
+
+    def one_request(i: int, rows: list) -> None:
+        import http.client
+
+        t0 = time.monotonic()
+        status = None
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", gport,
+                                              timeout=120)
+            conn.request("POST", "/v1/generate", body=json.dumps({
+                "prompt": f"turn {i}", "maxNewTokens": 8,
+                "prefixId": f"sess-{i % 16}", "deadlineS": 60}),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            status = resp.status
+            if status == 200:
+                with lock:
+                    tokens[0] += json.loads(body).get("numTokens", 0)
+        except Exception:  # noqa: BLE001 — a transport error is a data point
+            status = -1
+        with lock:
+            rows.append((status, time.monotonic() - t0))
+
+    stage_results = []
+    for name, rate in stages:
+        rows: list = []
+        threads = []
+        t_end = time.monotonic() + stage_s
+        i = 0
+        while time.monotonic() < t_end:
+            th = threading.Thread(target=one_request, args=(i, rows))
+            th.start()
+            threads.append(th)
+            i += 1
+            time.sleep(1.0 / rate)
+        for th in threads:
+            th.join(timeout=300)
+        lat = sorted(t for s, t in rows if s == 200)
+        stage_results.append({
+            "stage": name, "target_rps": rate, "requests": len(rows),
+            "qps": round(len(rows) / stage_s, 2),
+            "p95_s": (round(lat[int(0.95 * (len(lat) - 1))], 4)
+                      if lat else None),
+            "statuses": {str(k): sum(1 for s, _t in rows if s == k)
+                         for k in sorted({s for s, _t in rows})},
+        })
+        _log(f"diurnal stage {name}: {json.dumps(stage_results[-1])}")
+    dt = time.monotonic() - t_run0
+
+    spill = {k: int(gw.registry.get("kukeon_gateway_spill_total").value(
+        outcome=k)) for k in ("recovered", "timeout", "overflow", "fault")}
+    total = sum(r["requests"] for r in stage_results)
+    failed = sum(v for r in stage_results
+                 for s, v in r["statuses"].items() if s != "200")
+    diurnal = {
+        "stages": stage_results,
+        "spill": spill,
+        "peak_p95_s": stage_results[1]["p95_s"],
+        "requests": total,
+        "failed": failed,
+    }
+    serve = {
+        "metric": f"diurnal ramp through the gateway, {n} replicas, "
+                  f"tiny [{backend}]",
+        "backend": backend, "model": "tiny", "model_id": "tiny",
+        "n_chips": len(jax.devices()), "replicas": n,
+        "sessions": 16, "max_sessions": 16,
+        "tok_per_s": round(tokens[0] / dt, 2),
+        "trials": [round(tokens[0] / dt, 1)],
+    }
+    result = {**serve, "diurnal": diurnal}
+    gw_srv.shutdown()
+    gw.stop()
+    for srv in servers:
+        srv.shutdown()
+    for cell in cells:
+        cell.engine.stop()
+    if args.out:
+        write_artifact(args.out, serve, result)
+    print(json.dumps(result), flush=True)
+
+
 def phase_embed(args) -> None:
     """Embedding-cell throughput (BASELINE config 5: bge-base embedding
     serving): sequences/s for batched ~128-token inputs."""
@@ -1141,7 +1273,12 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", default="all",
                     choices=["all", "serve", "embed", "ab", "autotune",
-                             "gateway", "mixed", "disagg"])
+                             "gateway", "mixed", "disagg", "diurnal"])
+    # Diurnal ramp through the gateway + spillover (phase_diurnal): the
+    # night->peak->trough arrival schedule with a deliberately
+    # under-provisioned fleet; the headline numbers are zero client-visible
+    # 429s during the peak's shed storm and the per-stage client p95.
+    ap.add_argument("--diurnal", action="store_true")
     # Mixed agent-session workload at fixed KV HBM (phase_mixed): legacy
     # vs paged engine, max concurrent sessions + aggregate tok/s per arm.
     ap.add_argument("--mixed", action="store_true")
@@ -1170,11 +1307,11 @@ def main() -> None:
     # contiguous layout; > 0 = block-table page pool with this page size.
     ap.add_argument("--kv-page-tokens", type=int, default=None)
     # Standardized trajectory artifact (e.g. --out BENCH_r06.json): one
-    # schema-versioned JSON file per run (kukeon-bench/v4; read_artifact
-    # upgrades v1-v3 points) with percentiles, throughput, compile counts,
-    # peak HBM, replica count, and the disaggregation section, so
-    # BENCH_*.json points stay comparable across rounds regardless of how
-    # the console line evolves.
+    # schema-versioned JSON file per run (kukeon-bench/v5; read_artifact
+    # upgrades v1-v4 points) with percentiles, throughput, compile counts,
+    # peak HBM, replica count, and the disaggregation + diurnal sections,
+    # so BENCH_*.json points stay comparable across rounds regardless of
+    # how the console line evolves.
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -1183,6 +1320,9 @@ def main() -> None:
         return
     if args.disagg or args.phase == "disagg":
         phase_disagg(args)
+        return
+    if args.diurnal or args.phase == "diurnal":
+        phase_diurnal(args)
         return
     if args.mixed or args.phase == "mixed":
         phase_mixed(args)
@@ -1350,14 +1490,16 @@ def read_artifact(path: str) -> dict:
     ``max_sessions`` equal to their session count; v1–v3 points
     (pre-disaggregation) gain ``ttft_p95_s`` (lifted from their latency
     percentiles when present), ``handoff_ms_p50: None`` (no KV handoff
-    existed), and ``disagg: None``."""
+    existed), and ``disagg: None``; v1–v4 points (pre-autoscaling) gain
+    ``diurnal: None`` (no diurnal-ramp phase existed)."""
     with open(path) as f:
         artifact = json.load(f)
     schema = artifact.get("schema")
     if schema not in ("kukeon-bench/v1", "kukeon-bench/v2",
-                      "kukeon-bench/v3", "kukeon-bench/v4"):
+                      "kukeon-bench/v3", "kukeon-bench/v4",
+                      "kukeon-bench/v5"):
         raise ValueError(f"unknown bench artifact schema {schema!r} in {path}")
-    if schema != "kukeon-bench/v4":
+    if schema != "kukeon-bench/v5":
         artifact = dict(artifact)
         artifact.setdefault("replicas", 1)              # v1 -> v2
         artifact.setdefault("kv_page_tokens", 0)        # v2 -> v3
@@ -1366,7 +1508,8 @@ def read_artifact(path: str) -> dict:
         artifact.setdefault("ttft_p95_s", lat.get("p95"))   # v3 -> v4
         artifact.setdefault("handoff_ms_p50", None)
         artifact.setdefault("disagg", None)
-        artifact["schema"] = "kukeon-bench/v4"
+        artifact.setdefault("diurnal", None)            # v4 -> v5
+        artifact["schema"] = "kukeon-bench/v5"
     return artifact
 
 
@@ -1374,7 +1517,7 @@ def write_artifact(path: str, serve: dict, result: dict) -> None:
     """The standardized BENCH_rNN.json trajectory point: fixed schema, one
     file per run, every field from the product's own instruments."""
     artifact = {
-        "schema": "kukeon-bench/v4",
+        "schema": "kukeon-bench/v5",
         "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "backend": serve["backend"],
         "n_chips": serve["n_chips"],
@@ -1407,6 +1550,9 @@ def write_artifact(path: str, serve: dict, result: dict) -> None:
             ((serve.get("latency_s") or {}).get("ttft") or {}).get("p95")),
         "handoff_ms_p50": result.get("handoff_ms_p50"),
         "disagg": result.get("disagg"),
+        # v5: the diurnal-ramp section (per-stage qps/p95/statuses plus
+        # the gateway spillover outcome counters) when `--diurnal` ran.
+        "diurnal": result.get("diurnal"),
         "cold_start": result.get("cold_start"),
         "embedding": result.get("embedding"),
         "mixed": result.get("mixed"),

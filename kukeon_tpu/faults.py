@@ -56,6 +56,9 @@ POINTS = (
     "kv.alloc",
     "kv.handoff",
     "cell.http",
+    "gateway.spill",
+    "scaler.tick",
+    "alerts.webhook",
     "checkpoint.save",
     "checkpoint.load",
     "devices.probe_wedged",

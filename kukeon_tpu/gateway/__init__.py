@@ -24,5 +24,6 @@ from kukeon_tpu.gateway.router import ReplicaState, Router  # noqa: F401
 from kukeon_tpu.gateway.rollout import (  # noqa: F401
     RolloutError,
     RolloutStep,
+    drain_replica,
     rolling_restart,
 )

@@ -23,9 +23,15 @@ Retry contract: a replica answering 429/503, or refusing the connection,
 triggers a bounded retry on another replica (each replica tried at most
 once per request). NEVER for mid-stream failures — by then bytes are on
 the client's wire, so the failure surfaces as the in-band terminal
-``{"error": ...}`` ndjson line the serving cell already speaks. When every
-replica failed, the last replica's 429/503 passes through (with its
-Retry-After); if nothing was reachable at all the gateway sheds 503.
+``{"error": ...}`` ndjson line the serving cell already speaks.
+
+Spillover: when EVERY replica shed (or nothing was routable), the request
+parks in a bounded deadline-aware queue and retries as replicas free —
+a brief all-shed storm becomes latency, not client-visible 429s. Past the
+request's deadline the gateway answers the in-band timeout terminal; a
+full spill queue (or the armed ``gateway.spill`` fault point) degrades to
+the old contract — the last replica's 429/503 passes through (with its
+Retry-After), and nothing-reachable sheds 503.
 """
 
 from __future__ import annotations
@@ -40,6 +46,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
+from kukeon_tpu import faults, sanitize
 from kukeon_tpu.obs import Registry, Tracer, expo
 from kukeon_tpu.obs import trace as obs_trace
 from kukeon_tpu.gateway.router import Router
@@ -48,6 +55,16 @@ from kukeon_tpu.gateway.router import Router
 # replicas blip for poll-interval-sized windows, not minutes.
 GATEWAY_RETRY_AFTER_S = 2.0
 STREAM_CHUNK = 65536
+
+# Spillover defaults: how many all-shed requests may park at the gateway
+# (past it the original 429/503 passes through — the queue is a shock
+# absorber, not an unbounded backlog) and the longest a request without
+# its own deadlineS waits before the in-band timeout terminal.
+SPILL_CAPACITY = 64
+SPILL_MAX_WAIT_S = 10.0
+# Parked requests retry on every router-poll wakeup; this timed wait is
+# the backstop cadence when no poll lands (and the loop's deadline check).
+SPILL_WAIT_TICK_S = 0.05
 
 
 class GatewayCell:
@@ -59,13 +76,24 @@ class GatewayCell:
                  poll_interval_s: float = 0.5,
                  poll_timeout_s: float = 1.0,
                  request_timeout_s: float = 120.0,
-                 trace_capacity: int = 512):
+                 trace_capacity: int = 512,
+                 spill_capacity: int = SPILL_CAPACITY,
+                 spill_max_wait_s: float = SPILL_MAX_WAIT_S):
         self.model_name = model
         self.request_timeout_s = request_timeout_s
         self.router = Router(
             [(f"r{i}", u) for i, u in enumerate(replica_urls)],
             poll_interval_s=poll_interval_s, poll_timeout_s=poll_timeout_s)
         self.started_at = time.time()
+        # Spillover: an all-shed request parks here (bounded, deadline-
+        # aware) instead of handing the client the 429 — see spill_or_shed.
+        self.spill_capacity = spill_capacity
+        self.spill_max_wait_s = spill_max_wait_s
+        self._spill_lock = sanitize.lock("GatewayCell._spill_lock")
+        self._spill_cond = sanitize.condition(
+            self._spill_lock, name="GatewayCell._spill_cond")
+        self._spill_depth = 0   # guarded-by: _spill_lock
+        self.router.add_poll_listener(self._spill_wake)
         # Distributed tracing: the gateway is where a request's trace is
         # born (or joined, when the client already carries a traceparent).
         # Its proxy span records every replica attempt + retry hop and
@@ -129,6 +157,24 @@ class GatewayCell:
             "Requests that degraded to single-cell local decode after a "
             "handoff stage failed (the graceful path — client still gets "
             "200).")
+        self._m_spill = reg.counter(
+            "kukeon_gateway_spill_total",
+            "All-shed requests parked in the gateway spillover queue, by "
+            "final outcome (recovered = a retry won a replica; timeout = "
+            "in-band deadline terminal; overflow = queue full, original "
+            "shed passed through; fault = gateway.spill chaos seam "
+            "degraded the path).", labels=("outcome",))
+        for outcome in ("recovered", "timeout", "overflow", "fault"):
+            # Declared at 0 so a quiet gateway scrapes a stable schema.
+            self._m_spill.inc(0, outcome=outcome)
+        reg.gauge(
+            "kukeon_gateway_spill_queue_depth",
+            "Requests currently parked in the spillover queue."
+        ).set_function(lambda: float(self._spill_depth))
+        self._m_spill_wait = reg.histogram(
+            "kukeon_gateway_spill_wait_seconds",
+            "Time a spilled request spent parked before its outcome "
+            "(recovered, timeout, or a terminal shed).")
         ready_g = reg.gauge("kukeon_gateway_replica_ready",
                             "1 while the replica is in rotation.",
                             labels=("replica",))
@@ -307,6 +353,74 @@ class GatewayCell:
                             "retryAfterSeconds": GATEWAY_RETRY_AFTER_S}
                            ).encode(),
                 str(GATEWAY_RETRY_AFTER_S))
+
+    # --- spillover: park all-shed requests instead of 429ing ----------------
+
+    def _spill_wake(self) -> None:
+        """Router-poll listener: capacity may have returned — wake every
+        parked request so it retries now, not at its timer backstop."""
+        with self._spill_lock:
+            self._spill_cond.notify_all()
+
+    def spill_or_shed(self, shed, retry, deadline_s: float, span=None):
+        """An all-shed verdict enters the bounded spillover queue: the
+        request parks at the gateway and re-routes when a replica frees
+        (router-poll wakeup, 50ms timer backstop) instead of passing the
+        429/503 through — a brief storm becomes client latency, never an
+        error. Three ways out:
+
+          - a retry wins a replica: return its ("response"/"inline", ...)
+            verdict (outcome ``recovered``);
+          - the deadline expires while parked: ("spill_timeout", shed) —
+            the handler renders the in-band timeout terminal;
+          - the queue is full, or the ``gateway.spill`` fault point is
+            armed: the ORIGINAL shed verdict passes through untouched
+            (bounded queue + chaos both degrade to the pre-spillover
+            contract, they never deadlock a handler thread).
+
+        ``retry`` re-runs this request's routing (single-hop or the
+        disaggregated two-stage driver); ``shed`` is refreshed on every
+        re-shed so a final passthrough carries the newest Retry-After."""
+        try:
+            faults.maybe_fail("gateway.spill")
+        except faults.FaultInjected:
+            self._m_spill.inc(outcome="fault")
+            return shed
+        with self._spill_lock:
+            if self._spill_depth >= self.spill_capacity:
+                self._m_spill.inc(outcome="overflow")
+                return shed
+            self._spill_depth += 1
+        t0 = time.monotonic()
+        deadline = t0 + max(0.0, deadline_s)
+        if span is not None:
+            span.event("spill_park")
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._m_spill.inc(outcome="timeout")
+                    return ("spill_timeout", shed)
+                with self._spill_lock:
+                    self._spill_cond.wait(
+                        timeout=min(SPILL_WAIT_TICK_S, remaining))
+                if not self.router.ready_count():
+                    # Nothing routable at all: retrying now would only
+                    # stampede the poll path. The background poll promotes
+                    # a recovered replica and wakes us.
+                    continue
+                got = retry()
+                if got[0] != "shed":
+                    self._m_spill.inc(outcome="recovered")
+                    if span is not None:
+                        span.event("spill_resume")
+                    return got
+                shed = got
+        finally:
+            self._m_spill_wait.observe(time.monotonic() - t0)
+            with self._spill_lock:
+                self._spill_depth -= 1
+                self._spill_cond.notify_all()
 
     # --- disaggregated two-stage routing (KV handoff) ----------------------
 
@@ -502,6 +616,13 @@ class GatewayCell:
                 v for _l, v in reg.get(
                     "kukeon_gateway_retries_total").samples())),
             "shed": int(reg.get("kukeon_gateway_shed_total").value()),
+            "spill": {
+                "depth": self._spill_depth,
+                "capacity": self.spill_capacity,
+                **{k: int(reg.get("kukeon_gateway_spill_total").value(
+                    outcome=k))
+                   for k in ("recovered", "timeout", "overflow", "fault")},
+            },
             # The gateway admits while >=1 replica does; surfacing the same
             # ready/draining keys as a serving cell keeps pollers uniform.
             "ready": self.router.ready_count() > 0,
@@ -607,10 +728,38 @@ def make_gateway_handler(gw: GatewayCell):
             if path == "/v1/generate" and gw.router.disaggregated():
                 # Role census says this fleet is disaggregated: drive the
                 # two-stage prefill-export -> decode-import handoff.
-                got = gw.handoff_and_proxy(req, body, prefix_id, stream,
-                                           span=span)
+                def route():
+                    return gw.handoff_and_proxy(req, body, prefix_id,
+                                                stream, span=span)
             else:
-                got = gw.select_and_proxy(path, body, prefix_id, span=span)
+                def route():
+                    return gw.select_and_proxy(path, body, prefix_id,
+                                               span=span)
+            got = route()
+            if got[0] == "shed":
+                # Spillover: every replica shed (or nothing was routable).
+                # Park the request and retry until a replica frees or the
+                # deadline runs out, bounded by the spill queue capacity.
+                d = req.get("deadlineS")
+                wait = (min(float(d), gw.spill_max_wait_s)
+                        if isinstance(d, (int, float)) and d > 0
+                        else gw.spill_max_wait_s)
+                got = gw.spill_or_shed(got, route, wait, span=span)
+            if got[0] == "spill_timeout":
+                # The deadline expired while parked. Mirror the serving
+                # cell's timeout contract: 504 + timedOut for a plain
+                # request; an in-band terminal line for a stream (the
+                # client asked for ndjson and nothing has been sent yet).
+                msg = {"error": "deadline exceeded while queued at the "
+                                "gateway (all replicas shedding)",
+                       "timedOut": True, "numTokens": 0}
+                if stream:
+                    self._send_raw(200, (json.dumps(msg) + "\n").encode(),
+                                   "application/x-ndjson")
+                else:
+                    self._send(504, msg)
+                gw.finish_span(span, "timeout")
+                return
             if got[0] == "inline":
                 # The gateway answered from the export header (terminal
                 # first token) or passes a 400 through.
@@ -714,11 +863,20 @@ def main(argv=None) -> int:
                     help="replica base URL (repeat per replica)")
     ap.add_argument("--poll-interval-s", type=float, default=0.5)
     ap.add_argument("--request-timeout-s", type=float, default=600.0)
+    ap.add_argument("--spill-capacity", type=int, default=SPILL_CAPACITY,
+                    help="max all-shed requests parked in the spillover "
+                         "queue (past it the shed passes through)")
+    ap.add_argument("--spill-max-wait-s", type=float,
+                    default=SPILL_MAX_WAIT_S,
+                    help="longest a spilled request without its own "
+                         "deadlineS waits before the timeout terminal")
     args = ap.parse_args(argv)
 
     gw = GatewayCell(args.model, args.replica,
                      poll_interval_s=args.poll_interval_s,
-                     request_timeout_s=args.request_timeout_s)
+                     request_timeout_s=args.request_timeout_s,
+                     spill_capacity=args.spill_capacity,
+                     spill_max_wait_s=args.spill_max_wait_s)
     gw.start()
     server = ThreadingHTTPServer((args.host, args.port),
                                  make_gateway_handler(gw))

@@ -27,7 +27,14 @@ class RolloutError(RuntimeError):
     """A replica failed to drain-exit or come back ready in time; the
     rollout stops HERE (continuing would drain the next replica while this
     one is down — exactly the capacity hole a rolling restart exists to
-    avoid)."""
+    avoid). ``results`` carries the per-step outcome records up to and
+    including the failed step, so an aborted rollout names exactly which
+    replicas were done and which one stalled — the operator can resume by
+    hand instead of re-rolling finished replicas blind."""
+
+    def __init__(self, message: str, results: list[dict] | None = None):
+        super().__init__(message)
+        self.results: list[dict] = results or []
 
 
 @dataclasses.dataclass
@@ -96,7 +103,9 @@ def rolling_restart(steps: list[RolloutStep], *,
                     ) -> list[dict]:
     """Run the drain → wait → restart → wait-ready cycle over every step in
     order. Returns one record per replica; raises RolloutError the moment a
-    replica cannot be brought back ready."""
+    replica cannot be brought back ready — with the per-step records so far
+    (done replicas plus the failed one, its ``error`` naming the stall)
+    attached as ``.results``, so an aborted rollout is resumable by hand."""
     ev = on_event or (lambda _replica, _what: None)
     results: list[dict] = []
     for step in steps:
@@ -110,15 +119,48 @@ def rolling_restart(steps: list[RolloutStep], *,
         drained = wait_drained(step.url, drain_timeout_s, poll_s=poll_s,
                                http_timeout_s=http_timeout_s)
         ev(step.name, "restart")
-        step.restart()
+        try:
+            step.restart()
+        except Exception as e:  # noqa: BLE001 — the summary must name the step
+            results.append({"replica": step.name, "drained": drained,
+                            "error": f"restart failed: "
+                                     f"{type(e).__name__}: {e}"})
+            raise RolloutError(
+                f"replica {step.name} restart failed "
+                f"({type(e).__name__}: {e}); rollout stopped "
+                f"({len(results) - 1} of {len(steps)} replicas done)",
+                results) from e
         ready_s = wait_ready(step.url, ready_timeout_s, poll_s=poll_s,
                              http_timeout_s=http_timeout_s)
         if ready_s is None:
+            results.append({
+                "replica": step.name, "drained": drained,
+                "error": f"not ready within {ready_timeout_s:.0f}s "
+                         "after restart"})
             raise RolloutError(
                 f"replica {step.name} did not become ready within "
                 f"{ready_timeout_s:.0f}s after restart; rollout stopped "
-                f"({len(results)} of {len(steps)} replicas done)")
+                f"({len(results) - 1} of {len(steps)} replicas done)",
+                results)
         ev(step.name, "ready")
         results.append({"replica": step.name, "drained": drained,
                         "readyS": round(ready_s, 3)})
     return results
+
+
+def drain_replica(url: str, *, drain_timeout_s: float = 30.0,
+                  poll_s: float = 0.1, http_timeout_s: float = 2.0) -> bool:
+    """The scale-down primitive: ask one replica to drain and wait for it
+    to finish (a drained serving cell exits its HTTP server, so
+    *unreachable* is the authoritative drained signal — a replica that
+    died mid-drain still counts as drained, capacity-wise it is already
+    gone). True once drained; False when the replica is still serving past
+    the timeout — the caller must NOT remove it (that would lose its
+    in-flight requests) and should retry later."""
+    try:
+        _post(url + "/drain", http_timeout_s)
+    except (urllib.error.URLError, OSError):
+        # Already unreachable: dead-or-drained, either way removable.
+        pass
+    return wait_drained(url, drain_timeout_s, poll_s=poll_s,
+                        http_timeout_s=http_timeout_s)

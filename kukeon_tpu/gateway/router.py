@@ -33,7 +33,7 @@ import json
 import threading
 import time
 import urllib.request
-from typing import FrozenSet, Optional, Set, Union
+from typing import Callable, FrozenSet, List, Optional, Set, Union
 
 from kukeon_tpu import sanitize
 
@@ -121,6 +121,16 @@ class Router:
         self.poll_timeout_s = poll_timeout_s
         self._halt = sanitize.event("Router._halt")
         self._thread: Optional[threading.Thread] = None
+        # Run after every completed poll pass. The gateway's spillover
+        # queue registers its wakeup here: a parked request retries the
+        # moment a poll shows capacity returned instead of sleeping out
+        # its own timer.
+        self._poll_listeners: List[Callable[[], None]] = []
+
+    def add_poll_listener(self, fn: Callable[[], None]) -> None:
+        """Register a callback invoked after each poll pass (listener
+        exceptions are swallowed — routing must never die to a waiter)."""
+        self._poll_listeners.append(fn)
 
     # --- polling -----------------------------------------------------------
 
@@ -141,6 +151,11 @@ class Router:
                 rep.poll_ok = False
                 rep.ready = False
             rep.last_poll_at = time.monotonic()
+        for fn in list(self._poll_listeners):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a waiter must not kill polling
+                pass
 
     def start(self) -> None:
         if self._thread is not None:

@@ -45,12 +45,17 @@ import time
 from collections import deque
 from typing import Callable
 
-from kukeon_tpu import sanitize
+from kukeon_tpu import faults, sanitize
 from kukeon_tpu.obs import tsdb as tsdb_mod
 
 RULES_ENV = "KUKEON_ALERT_RULES"
 WEBHOOK_ENV = "KUKEON_ALERT_WEBHOOK"
 WEBHOOK_TIMEOUT_S = 2.0
+# One bounded retry after a failed delivery POST: a page lost to a single
+# dropped connection is the worst kind of silent failure, but an alert
+# webhook is not a durable queue either — one backoff'd re-send, then the
+# error is counted and logged.
+WEBHOOK_RETRY_BACKOFF_S = 0.5
 
 SEVERITIES = ("info", "warning", "critical")
 OPS = (">", "<")
@@ -390,18 +395,31 @@ class AlertEngine:
 
     def _post_webhook(self, tr: dict) -> None:
         import urllib.request
-        try:
-            req = urllib.request.Request(
-                self._webhook_url, data=json.dumps(tr).encode(),
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=WEBHOOK_TIMEOUT_S):
-                pass
-            if self._m_webhook is not None:
-                self._m_webhook.inc(result="ok")
-        except Exception as e:  # noqa: BLE001 — a dead webhook must not matter
-            log.warning("alert webhook POST failed: %s", e)
-            if self._m_webhook is not None:
-                self._m_webhook.inc(result="error")
+        for attempt in (0, 1):
+            try:
+                # The chaos seam: `alerts.webhook` armed fails the POST
+                # before the socket, so the retry/backoff path is testable
+                # without a flaky endpoint.
+                faults.maybe_fail("alerts.webhook")
+                req = urllib.request.Request(
+                    self._webhook_url, data=json.dumps(tr).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=WEBHOOK_TIMEOUT_S):
+                    pass
+                if self._m_webhook is not None:
+                    self._m_webhook.inc(
+                        result="retried" if attempt else "ok")
+                return
+            except Exception as e:  # noqa: BLE001 — a dead webhook must not matter
+                if attempt:
+                    log.warning("alert webhook POST failed after retry: %s",
+                                e)
+                    if self._m_webhook is not None:
+                        self._m_webhook.inc(result="error")
+                    return
+                log.warning("alert webhook POST failed (%s); retrying in "
+                            "%.1fs", e, WEBHOOK_RETRY_BACKOFF_S)
+                time.sleep(WEBHOOK_RETRY_BACKOFF_S)
 
     # --- views ----------------------------------------------------------------
 

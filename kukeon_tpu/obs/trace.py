@@ -82,6 +82,10 @@ PHASES = (
     "kv_exported", "kv_imported", "kv_handoff", "handoff_fallback",
     # gateway proxy hops (gateway/cell.py)
     "proxy_attempt", "proxy_retry", "proxy_shed",
+    # gateway spillover (gateway/cell.py): an all-shed request parking in
+    # the bounded deadline-aware queue, and its later retry winning a
+    # replica — a brief storm rendered as latency, not an error.
+    "spill_park", "spill_resume",
     # cell boot phases (runtime/serving_cell.py finish_boot)
     "boot_imports", "boot_init", "boot_compile", "boot_warmup",
 )

@@ -170,6 +170,19 @@ class ModelSpec:
     # prefix-id affinity (kukeon_tpu/gateway). The client-facing endpoint
     # is ``port`` either way; replicas=1 keeps the single-engine shape.
     replicas: int = 1
+    # SLO-driven autoscaling bounds (runtime/scaler.py): setting
+    # ``maxReplicas`` arms the daemon's FleetScaler for this cell — the
+    # runner materializes the full port range and chip partition up to the
+    # bound, and the scaler moves the ACTIVE replica count between
+    # ``minReplicas`` (default 1) and ``maxReplicas`` from windowed SLO
+    # burn rate + aggregate queue depth, debounced through the alert
+    # engine's pending->firing state machine. ``replicas`` is the initial
+    # active count and must sit inside the bounds. Scale-up starts a
+    # parked replica on its pre-partitioned chip grant; scale-down drains
+    # through the gateway first, so no in-flight request is lost. Unset =
+    # the static replica set, byte-identical to before autoscaling.
+    min_replicas: int | None = None
+    max_replicas: int | None = None
     # Disaggregated prefill/decode serving (FlexNPU-style): "mixed" (the
     # default — every replica serves both phases, byte-identical to the
     # pre-role behavior), or a comma-separated per-replica role list

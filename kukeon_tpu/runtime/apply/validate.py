@@ -255,6 +255,33 @@ def validate_cell(spec: t.CellSpec, ctx: str, *, in_blueprint: bool = False) -> 
                     f"{ctx}: model port {p} (of replica range "
                     f"{ports[0]}..{ports[-1]}) collides with a container port"
                 )
+        if m.min_replicas is not None and m.max_replicas is None:
+            raise InvalidArgument(
+                f"{ctx}: model.minReplicas without model.maxReplicas does "
+                "nothing — set maxReplicas to arm autoscaling")
+        if m.max_replicas is not None:
+            lo = m.min_replicas if m.min_replicas is not None else 1
+            if lo < 1:
+                raise InvalidArgument(
+                    f"{ctx}: model.minReplicas must be >= 1, got {lo}")
+            if m.max_replicas < 2:
+                raise InvalidArgument(
+                    f"{ctx}: model.maxReplicas must be >= 2 (an autoscaled "
+                    "cell serves through the gateway, which needs a "
+                    "replicated port range)")
+            if m.max_replicas < lo:
+                raise InvalidArgument(
+                    f"{ctx}: model.maxReplicas ({m.max_replicas}) must be "
+                    f">= minReplicas ({lo})")
+            if not (lo <= m.replicas <= m.max_replicas):
+                raise InvalidArgument(
+                    f"{ctx}: model.replicas ({m.replicas}) must sit inside "
+                    f"the autoscale bounds [{lo}, {m.max_replicas}]")
+            if (m.role or "mixed").strip() != "mixed":
+                raise InvalidArgument(
+                    f"{ctx}: model.role {m.role!r} cannot combine with "
+                    "autoscaling bounds — the scaler assumes a homogeneous "
+                    "(mixed) replica pool")
         roles = model_roles(m, ctx)
         if any(r != "mixed" for r in roles):
             # A heterogeneous fleet must still be able to COMPLETE a
@@ -319,11 +346,21 @@ def model_roles(m: t.ModelSpec, ctx: str | None = None) -> list[str]:
     return atoms
 
 
+def model_scale_bound(m: t.ModelSpec) -> int:
+    """The largest replica count this spec can ever run: ``maxReplicas``
+    when autoscaling is armed, else the static ``replicas``. The runner
+    materializes containers, ports, and the chip partition up to this
+    bound so a scale-up never renumbers an existing replica's grant."""
+    return max(m.replicas or 1, m.max_replicas or 0)
+
+
 def model_ports(m: t.ModelSpec) -> list[int]:
     """Every TCP port a ModelSpec's cell claims: just ``port`` for a single
     engine; the gateway on ``port`` plus replicas on ``port+1..port+N``
-    when replicated (the runner's base-port scheme)."""
-    n = m.replicas or 1
+    when replicated (the runner's base-port scheme). An autoscaled cell
+    claims its full ``maxReplicas`` range up front — a parked replica's
+    port is reserved, never re-leased."""
+    n = model_scale_bound(m)
     if n <= 1:
         return [m.port]
     return list(range(m.port, m.port + n + 1))

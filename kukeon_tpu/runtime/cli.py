@@ -678,6 +678,12 @@ def render_top(rows, sparks=None) -> str:
             # live on the per-replica rows beneath it.
             ready = (f"{r.get('readyReplicas', 0)}/{r.get('replicas', '?')}")
             extra = f"  (gateway, retries={r.get('retries', 0)}"
+            if r.get("scale"):
+                # Autoscaled cell: the FleetScaler's current target and
+                # the declared bounds.
+                sc = r["scale"]
+                extra += (f", scale={sc.get('desired', '?')}"
+                          f"[{sc.get('min', 1)}..{sc.get('max', '?')}]")
             if r.get("handoffs"):
                 # Disaggregated fleet: how many prefill->decode KV
                 # handoffs this gateway drove, at what median cost.
@@ -830,11 +836,18 @@ def cmd_query(args):
 def cmd_alerts(args):
     """The alert engine's live state (one row per rule, plus one per
     active labelset) and its recent firing/resolved transitions — the
-    operator view of kukeon_alerts_firing."""
+    operator view of kukeon_alerts_firing. ``--check`` turns it into a
+    health gate for CI and cron: exit 1 while any rule is firing, 2 when
+    the user rule file is broken (rulesError), 0 on a quiet fleet."""
     out = _client(args).call("Alerts",
                              transitions=getattr(args, "transitions", 50))
+    check = getattr(args, "check", False)
     if args.json:
         _print(out, True)
+        if check:
+            if any(r["state"] == "firing" for r in out.get("alerts", [])):
+                return 1
+            return 2 if out.get("rulesError") else 0
         return 0
     if out.get("rulesError"):
         print(f"warning: KUKEON_ALERT_RULES ignored: {out['rulesError']}",
@@ -862,6 +875,18 @@ def cmd_alerts(args):
             print(f"  {ts} {tr['alert']} -> {tr['state']} "
                   f"(value {tr['value']:.4g} vs {tr['threshold']:.4g})"
                   f"{extra}")
+    if check:
+        firing = [r["alert"] for r in out.get("alerts", [])
+                  if r["state"] == "firing"]
+        if firing:
+            print(f"\ncheck: {len(firing)} rule(s) firing: "
+                  + ", ".join(sorted(set(firing))), file=sys.stderr)
+            return 1
+        if out.get("rulesError"):
+            # Nothing firing, but the operator's rule file is broken —
+            # the gate cannot vouch for rules that never loaded.
+            return 2
+        print("\ncheck: fleet healthy (nothing firing)")
     return 0
 
 
@@ -957,6 +982,46 @@ def cmd_trace(args):
     return 0 if spans else 1
 
 
+def cmd_scale(args):
+    """The autoscaler's status verb: one row per autoscaled model cell —
+    active target vs declared bounds, the latest queue-pressure and SLO
+    burn signals, each decision rule's debounce state — plus the recent
+    scale events (up/down/aborted with reasons). Read-only: the scaler
+    itself decides; this is how the operator watches it decide."""
+    out = _client(args).call("ScaleStatus")
+    cells = out.get("cells", [])
+    if getattr(args, "name", None):
+        cells = [c for c in cells if c["cell"].endswith("/" + args.name)
+                 or c["cell"] == args.name]
+    if args.json:
+        _print({"cells": cells, "events": out.get("events", [])}, True)
+        return 0
+    if not cells:
+        print("no autoscaled model cells (set model.minReplicas/"
+              "maxReplicas, and give the daemon a telemetry tick)")
+        return 1
+    fmt = "{:<32} {:>8} {:>7} {:>11} {:>8} {}"
+    print(fmt.format("CELL", "REPLICAS", "BOUNDS", "QUEUE-RATIO", "BURN",
+                     "RULES"))
+    for c in sorted(cells, key=lambda c: c["cell"]):
+        rules = c.get("rules") or {}
+        lit = [f"{k}={v}" for k, v in sorted(rules.items()) if v != "ok"]
+        print(fmt.format(
+            c["cell"], c.get("active", "?"),
+            f"{c.get('min', 1)}..{c.get('max', '?')}",
+            f"{c.get('queueRatio', 0):.3f}", f"{c.get('burnRate', 0):.2f}",
+            " ".join(lit) if lit else "quiet"))
+    events = out.get("events", [])
+    if events:
+        print("\nrecent scale events:")
+        for ev in events[-10:]:
+            ts = time.strftime("%H:%M:%S", time.localtime(ev["at"]))
+            arrow = {"up": "+1", "down": "-1"}.get(ev["direction"], "?")
+            print(f"  {ts} {ev['cell']} {arrow} -> {ev.get('to', '?')} "
+                  f"[{ev['result']}] {ev.get('reason', '')}")
+    return 0
+
+
 def cmd_rollout(args):
     """Rolling restart of a replicated model cell (drain -> restart ->
     ready, one replica at a time; the daemon drives it, the gateway keeps
@@ -968,10 +1033,21 @@ def cmd_rollout(args):
                  readyTimeoutS=args.ready_timeout)
     if args.json:
         _print(out, True)
-        return 0
+        return 1 if out.get("aborted") else 0
     for r in out["replicas"]:
         drained = "drained" if r["drained"] else "drain timeout (restarted anyway)"
-        print(f"  {r['replica']}: {drained}, ready again in {r['readyS']}s")
+        if r.get("error"):
+            print(f"  {r['replica']}: {drained}, FAILED: {r['error']}")
+        else:
+            print(f"  {r['replica']}: {drained}, ready again in {r['readyS']}s")
+    if out.get("aborted"):
+        # The per-step records above say exactly which replicas finished;
+        # re-running `kuke rollout` after fixing the stalled one is safe
+        # (a healthy replica just drains and restarts again).
+        done = sum(1 for r in out["replicas"] if not r.get("error"))
+        print(f"cell/{args.name}: rollout ABORTED after {done} replica(s): "
+              f"{out.get('error')}", file=sys.stderr)
+        return 1
     print(f"cell/{args.name}: rollout complete "
           f"({len(out['replicas'])} replicas)")
     return 0
@@ -1080,12 +1156,12 @@ _kuke_complete() {
     local cur="${COMP_WORDS[COMP_CWORD]}" prev="${COMP_WORDS[COMP_CWORD-1]}"
     local verbs="init apply create build daemon get delete doctor start status \
 stop team kill purge refresh rollout run attach log top trace query alerts \
-autocomplete image uninstall version"
+scale autocomplete image uninstall version"
     if [ "$COMP_CWORD" -eq 1 ]; then
         COMPREPLY=($(compgen -W "$verbs" -- "$cur")); return
     fi
     case "$prev" in
-        start|stop|kill|attach|log|run|rollout)
+        start|stop|kill|attach|log|run|rollout|scale)
             COMPREPLY=($(compgen -W "$(kuke autocomplete cells 2>/dev/null)" -- "$cur"));;
         get|delete|purge|create)
             COMPREPLY=($(compgen -W "realm space stack cell secret blueprint \
@@ -1270,6 +1346,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub_add("alerts")
     sp.add_argument("-n", "--transitions", type=int, default=50,
                     help="recent transitions to fetch")
+    sp.add_argument("--check", action="store_true",
+                    help="health gate: exit 1 while any rule is firing, "
+                         "2 on a broken KUKEON_ALERT_RULES file")
+
+    sp = sub_add("scale")
+    sp.add_argument("name", nargs="?", default=None,
+                    help="optional cell name filter")
+    _scope_args(sp)
 
     sp = sub_add("trace")
     sp.add_argument("trace_id",
@@ -1352,6 +1436,7 @@ HANDLERS = {
     "top": cmd_top,
     "query": cmd_query,
     "alerts": cmd_alerts,
+    "scale": cmd_scale,
     "trace": cmd_trace,
     "rollout": cmd_rollout,
     "doctor": cmd_doctor,

@@ -78,8 +78,14 @@ def model_cell_endpoints(ctl) -> list[tuple[str, str, dict]]:
             port = m.get("port", 9000)
             out.append((key, f"http://{host}:{port}", rec))
             replicas = m.get("replicas") or 1
-            if replicas > 1:
-                for i in range(replicas):
+            bound = max(replicas, m.get("maxReplicas") or 0)
+            if bound > 1:
+                # Only ACTIVE replicas federate: a parked (scaled-down)
+                # replica is intentionally dark, and scraping it would
+                # page CellScrapeDown for a replica the scaler chose to
+                # turn off.
+                active = st.get("targetReplicas") or replicas
+                for i in range(max(1, min(active, bound))):
                     out.append((f"{key}/r{i}",
                                 f"http://{host}:{port + 1 + i}", rec))
     return out
@@ -227,6 +233,12 @@ class FleetTelemetry:
                     "ignoring %s: %s", alerts_mod.RULES_ENV, e)
         self.alerts = alerts_mod.AlertEngine(
             self.tsdb, rules=rules, registry=self._reg, clock=clock)
+        # The autoscaling reconcile loop rides this same tick (after alert
+        # evaluation, so its decision rules see the freshest ingest).
+        from kukeon_tpu.runtime.scaler import FleetScaler
+
+        self.scaler = FleetScaler(ctl, self.tsdb, registry=self._reg,
+                                  clock=clock)
         self._m_scrape_dur = self._reg.histogram(
             "kukeon_daemon_scrape_duration_seconds",
             "Per-cell /metrics scrape wall time in the telemetry loop.",
@@ -284,7 +296,20 @@ class FleetTelemetry:
         for p in parts:
             self.tsdb.ingest(p, at=now)
         self._m_ticks.inc()
-        return self.alerts.evaluate(at=now)
+        transitions = self.alerts.evaluate(at=now)
+        # The scaler reconciles AFTER alerting so its debounce rules read
+        # this very tick's ingest. Its failures (including the armed
+        # scaler.tick chaos seam) are survival-bounded HERE: counted,
+        # logged, and the telemetry loop carries on — a crashed scaler
+        # must degrade to "no scaling", never take sensing down with it.
+        try:
+            self.scaler.tick(at=now)
+        except Exception:  # noqa: BLE001 — the chaos contract
+            self.scaler.note_error()
+            import logging
+            logging.getLogger("kukeon.scaler").exception(
+                "scaler tick failed; fleet unchanged this tick")
+        return transitions
 
 
 def _sample_value(fams: dict, name: str, **match) -> float | None:
@@ -745,6 +770,18 @@ class RPCService:
                    "restarts": sum(
                        c.get("restarts", 0) for c in
                        (rec.get("status") or {}).get("containers", []))}
+            m = (rec.get("spec") or {}).get("model") or {}
+            base_key = "/".join((rec.get("realm", ""), rec.get("space", ""),
+                                 rec.get("stack", ""), rec.get("name", "")))
+            if m.get("maxReplicas") and s["cell"] == base_key:
+                # The gateway row of an autoscaled cell carries the scale
+                # state so `kuke top` shows desired/bounds at a glance.
+                row["scale"] = {
+                    "desired": ((rec.get("status") or {}).get(
+                        "targetReplicas") or m.get("replicas") or 1),
+                    "min": m.get("minReplicas") or 1,
+                    "max": m["maxReplicas"],
+                }
             if s["ok"]:
                 fams = s["families"]
                 # A replicated cell's base endpoint is its gateway; its
@@ -833,14 +870,17 @@ class RPCService:
         m = rec.spec.model
         if m is None:
             raise FailedPrecondition(f"cell {name!r} is not a model cell")
-        if (m.replicas or 1) <= 1:
+        # An autoscaled cell rolls its ACTIVE replicas only — restarting a
+        # parked replica would start capacity the scaler turned off.
+        active = self.ctl.runner.model_target(rec)
+        if active <= 1:
             raise FailedPrecondition(
                 f"cell {name!r} has replicas=1; a rolling restart needs a "
                 "replicated model cell (set model.replicas >= 2)"
             )
         host = rec.status.ip or "127.0.0.1"
         steps = []
-        for i in range(m.replicas):
+        for i in range(active):
             cname = f"model-server-{i}"
             url = f"http://{host}:{m.port + 1 + i}"
 
@@ -848,16 +888,33 @@ class RPCService:
                 _rollout_restart(self.ctl, rec, cname)
 
             steps.append(ro.RolloutStep(name=cname, url=url, restart=restart))
+        cell_key = "/".join((rec.realm, rec.space, rec.stack, rec.name))
         try:
             results = ro.rolling_restart(
                 steps, drain_timeout_s=drainTimeoutS,
                 ready_timeout_s=readyTimeoutS)
         except ro.RolloutError as e:
-            # Typed so the CLI prints the stall cleanly instead of an
-            # "internal" traceback code.
-            raise FailedPrecondition(str(e)) from None
-        return {"cell": "/".join((rec.realm, rec.space, rec.stack, rec.name)),
-                "replicas": results}
+            # An aborted rollout is a RESULT, not an RPC failure: the
+            # per-step outcome summary (which replicas finished, which one
+            # stalled and why) is exactly what the operator needs to
+            # resume by hand, so it must reach the CLI instead of dying
+            # inside an error string.
+            import logging
+            logging.getLogger("kukeon.rollout").warning(
+                "rollout of %s aborted: %s; per-step outcomes: %s",
+                cell_key, e, e.results)
+            return {"cell": cell_key, "aborted": True, "error": str(e),
+                    "replicas": e.results}
+        return {"cell": cell_key, "replicas": results}
+
+    def ScaleStatus(self, events: int = 20) -> dict:
+        """The FleetScaler's view: one row per autoscaled cell (bounds,
+        active target, latest queue-ratio/burn signals, each decision
+        rule's debounce state) plus the recent scale-event ring — what
+        `kuke scale` renders. Rows reflect the last telemetry tick; a
+        fresh daemon that has not ticked yet returns no cells."""
+        scaler = self.telemetry.scaler
+        return {"cells": scaler.states(), "events": scaler.events(events)}
 
     def Status(self) -> dict:
         ms = self.ctl.store.ms

@@ -80,6 +80,13 @@ class CellStatus:
     out_of_sync: bool = False
     out_of_sync_reason: str | None = None
     out_of_sync_error: str | None = None
+    # Autoscaling (runtime/scaler.py): the ACTIVE replica count of a model
+    # cell with minReplicas/maxReplicas bounds. None = the spec's static
+    # ``replicas``. Replicas with index >= target are "parked": their
+    # container specs, ports, and chip slices stay materialized (so a
+    # scale-up re-starts them on exactly their grant) but the runner
+    # neither starts nor heals them.
+    target_replicas: int | None = None
 
     def container(self, name: str) -> ContainerStatus | None:
         for c in self.containers:

@@ -32,6 +32,7 @@ from kukeon_tpu.runtime.devices import TPUDeviceManager
 from kukeon_tpu.runtime.errors import (
     DiskPressure,
     FailedPrecondition,
+    InvalidArgument,
     NotFound,
 )
 from kukeon_tpu.runtime.store import ResourceStore
@@ -270,16 +271,26 @@ class Runner:
         ``chips`` grant — declaration order partitions the cell's chips
         deterministically, so a restarted replica gets ITS chips back) plus
         one chip-less ``gateway`` container on ``m.port`` so the
-        client-facing endpoint never moves."""
-        from kukeon_tpu.runtime.apply.validate import model_roles
+        client-facing endpoint never moves. An autoscaled cell
+        (``maxReplicas``) materializes the FULL bound — replicas above the
+        active target stay parked (never started) but keep their name,
+        port, and chip slice, so the scaler's scale-up is just "start
+        container i on its grant", never a re-partition."""
+        from kukeon_tpu.runtime.apply.validate import (
+            model_roles,
+            model_scale_bound,
+        )
 
-        n = m.replicas or 1
+        n = model_scale_bound(m)
         roles = model_roles(m)
         if n <= 1:
             return [self._model_container(m, role=roles[0])]
         out = [
-            self._model_container(m, name=f"model-server-{i}",
-                                  port=m.port + 1 + i, role=roles[i])
+            self._model_container(
+                m, name=f"model-server-{i}", port=m.port + 1 + i,
+                # Autoscaled cells are validated role="mixed"; a static
+                # replica set keeps its per-replica role atoms.
+                role=roles[i] if i < len(roles) else "mixed")
             for i in range(n)
         ]
         out.append(self._gateway_container(m))
@@ -294,7 +305,12 @@ class Runner:
             cmd += ["--host", "0.0.0.0"]
         # Replicas share the cell's netns (or the host loopback on the
         # process backend), so the gateway always reaches them on 127.0.0.1.
-        for i in range(m.replicas):
+        # The gateway learns the FULL scale bound: a parked replica simply
+        # polls unready until the scaler starts it, then joins rotation on
+        # the next poll tick with no gateway restart.
+        from kukeon_tpu.runtime.apply.validate import model_scale_bound
+
+        for i in range(model_scale_bound(m)):
             cmd += ["--replica", f"http://127.0.0.1:{m.port + 1 + i}"]
         return t.ContainerSpec(
             name="gateway",
@@ -363,6 +379,39 @@ class Runner:
     def _owner_key(self, rec: model.CellRecord) -> str:
         return f"{rec.realm}/{rec.space}/{rec.stack}/{rec.name}"
 
+    @staticmethod
+    def model_target(rec: model.CellRecord) -> int:
+        """The ACTIVE replica count of a model cell: the scaler-written
+        ``status.target_replicas`` when set, else the spec's static
+        ``replicas`` — always clamped into [minReplicas, scale bound] so a
+        stale record can never park the whole fleet or start past the
+        bound."""
+        from kukeon_tpu.runtime.apply.validate import model_scale_bound
+
+        m = rec.spec.model
+        if m is None:
+            return 0
+        bound = model_scale_bound(m)
+        target = rec.status.target_replicas
+        if target is None:
+            target = m.replicas or 1
+        return max(max(1, m.min_replicas or 1), min(target, bound))
+
+    def _parked_names(self, rec: model.CellRecord) -> set[str]:
+        """Container names of replicas scaled out of the active range:
+        materialized (name/port/chip slice reserved) but intentionally not
+        running — start, heal, and phase derivation all skip them."""
+        from kukeon_tpu.runtime.apply.validate import model_scale_bound
+
+        m = rec.spec.model
+        if m is None:
+            return set()
+        bound = model_scale_bound(m)
+        if bound <= 1:
+            return set()
+        target = self.model_target(rec)
+        return {f"model-server-{i}" for i in range(target, bound)}
+
     def start_cell(self, realm: str, space: str, stack: str, name: str) -> model.CellRecord:
         with self.cell_lock(realm, space, stack, name):
             rec = self.store.read_cell(realm, space, stack, name)
@@ -380,6 +429,7 @@ class Runner:
         self._ensure_cell_network(rec)
 
         slices = self._chip_slices(containers, chips)
+        parked = self._parked_names(rec)
         new_statuses = []
         for spec in containers:
             ctx = self._container_context(rec, spec)
@@ -389,7 +439,7 @@ class Runner:
                 ctx.devices = self.devices.device_nodes(grant)
             st = rec.status.container(spec.name) or model.ContainerStatus(name=spec.name)
             live = self.backend.container_state(ctx)
-            if not live.running:
+            if not live.running and spec.name not in parked:
                 self.backend.start_container(ctx)
                 live = self.backend.container_state(ctx)
                 st.started_at = time.time()
@@ -823,6 +873,93 @@ class Runner:
             self.store.write_cell(rec)
             return rec
 
+    def scale_model_cell(self, realm: str, space: str, stack: str,
+                         name: str, target: int) -> model.CellRecord:
+        """Set the ACTIVE replica count of an autoscaled model cell — the
+        FleetScaler's one write primitive. Scale-up starts the newly
+        in-range replicas on their pre-partitioned chip grants (the cell's
+        whole ``maxReplicas`` grant was allocated at start, so no device
+        negotiation happens here); scale-down stops the now-out-of-range
+        replicas — the caller MUST have drained them through the gateway
+        first, this method only finishes the exit. The record (target and
+        statuses together) is written once at the end, so a crash mid-call
+        degrades to "replica still active under the old target" — the
+        reconcile loop heals it back to serving — never to a capacity
+        hole. Starts are idempotent: a replica a crashed earlier attempt
+        left running is simply adopted."""
+        import signal as _signal
+
+        from kukeon_tpu.runtime.apply.validate import model_scale_bound
+
+        with self.cell_lock(realm, space, stack, name):
+            rec = self.store.read_cell(realm, space, stack, name)
+            m = rec.spec.model
+            if m is None:
+                raise InvalidArgument(f"cell {name!r} is not a model cell")
+            bound = model_scale_bound(m)
+            lo = max(1, m.min_replicas or 1)
+            if bound <= 1:
+                raise InvalidArgument(
+                    f"cell {name!r} has no replica range to scale "
+                    "(set model.maxReplicas)")
+            if not (lo <= target <= bound):
+                raise InvalidArgument(
+                    f"cell {name!r}: target {target} outside "
+                    f"[{lo}, {bound}]")
+            old = self.model_target(rec)
+            containers = self.cell_containers(rec)
+            by_name = {c.name: c for c in containers}
+            if target > old:
+                for i in range(old, target):
+                    spec = by_name[f"model-server-{i}"]
+                    self._ensure_cell_network(rec)
+                    ctx = self._container_context(rec, spec)
+                    grant = self._chip_slices(
+                        containers, rec.status.tpu_chips).get(spec.name, [])
+                    if grant:
+                        ctx.env.update(self.devices.visibility_env(grant))
+                        ctx.devices = self.devices.device_nodes(grant)
+                    if not self.backend.container_state(ctx).running:
+                        self.backend.start_container(ctx)
+                    live = self.backend.container_state(ctx)
+                    st = rec.status.container(spec.name)
+                    if st is None:
+                        st = model.ContainerStatus(name=spec.name)
+                        rec.status.containers.append(st)
+                    st.state = live.state
+                    st.pid = live.pid
+                    st.exit_code = live.exit_code
+                    st.started_at = time.time()
+                    st.finished_at = None
+            else:
+                for i in range(target, old):
+                    spec = by_name[f"model-server-{i}"]
+                    bare = self._container_context_bare(rec, spec)
+                    if self.backend.container_state(bare).running:
+                        # Normally already exited (the drain shuts the
+                        # cell down); the grace window covers a cell that
+                        # drained but wedged short of exit.
+                        self.backend.signal_container(bare, _signal.SIGTERM)
+                        deadline = time.monotonic() + self.opts.stop_grace_s
+                        while (time.monotonic() < deadline
+                               and self.backend.container_state(bare).running):
+                            time.sleep(0.05)
+                        if self.backend.container_state(bare).running:
+                            self.backend.signal_container(bare,
+                                                          _signal.SIGKILL)
+                    live = self.backend.container_state(bare)
+                    st = rec.status.container(spec.name)
+                    if st is not None:
+                        st.state = live.state
+                        st.pid = None
+                        st.exit_code = live.exit_code
+                        if st.finished_at is None:
+                            st.finished_at = time.time()
+            rec.status.target_replicas = target
+            self._derive_phase(rec)
+            self.store.write_cell(rec)
+            return rec
+
     def _container_context_bare(self, rec: model.CellRecord, spec: t.ContainerSpec) -> ContainerContext:
         """Context sufficient for signal/state/cleanup (no env building)."""
         cdir = self.store.container_dir(rec.realm, rec.space, rec.stack, rec.name, spec.name)
@@ -895,6 +1032,7 @@ class Runner:
         containers = self.cell_containers(rec)
         changed = False
         owner = self._owner_key(rec)
+        parked = self._parked_names(rec)
 
         for spec in containers:
             st = rec.status.container(spec.name)
@@ -903,6 +1041,20 @@ class Runner:
                 rec.status.containers.append(st)
             ctx = self._container_context_bare(rec, spec)
             live = self.backend.container_state(ctx)
+            if spec.name in parked:
+                # Scaled out of the active range: record what the backend
+                # sees (a drained replica exits 0) but never heal it — the
+                # restart policy below would tug against the scaler's
+                # scale-down forever.
+                if (live.state, live.pid, live.exit_code) != (
+                        st.state, st.pid, st.exit_code):
+                    changed = changed or st.state != live.state
+                    st.state = live.state
+                    st.pid = live.pid
+                    st.exit_code = live.exit_code
+                    if live.exited and st.finished_at is None:
+                        st.finished_at = time.time()
+                continue
             if (live.state, live.pid, live.exit_code) != (st.state, st.pid, st.exit_code):
                 if st.state != live.state:
                     changed = True
@@ -1110,7 +1262,11 @@ class Runner:
         return True
 
     def _derive_phase(self, rec: model.CellRecord) -> None:
-        states = [c.state for c in rec.status.containers]
+        # Parked (scaled-down) replicas are intentionally not running: a
+        # cell at its autoscale minimum is READY, not degraded.
+        parked = self._parked_names(rec)
+        states = [c.state for c in rec.status.containers
+                  if c.name not in parked]
         if not states:
             rec.status.phase = model.PENDING
             return

@@ -1,0 +1,374 @@
+"""FleetScaler: SLO-driven replica autoscaling for model cells.
+
+Closes the loop ROADMAP item 5 describes: the daemon's TSDB already holds
+windowed burn-rate and queue-depth history for every replica, the alert
+engine already implements the exact debounce a scaler needs
+(pending -> firing per labelset, ``for:`` hold, silent cancel), the runner
+can start/stop one replica container on a stable chip grant, and the
+gateway can drain a replica out of rotation without losing a request.
+This module wires those four primitives into a reconcile loop that rides
+the telemetry thread (``FleetTelemetry.tick`` calls :meth:`tick` after
+alert evaluation):
+
+1. **Sense.** For every running model cell with ``maxReplicas`` bounds,
+   aggregate the active replicas' queue depth into one pressure ratio
+   (``sum(queue) / (active * max_pending)``) and take the worst 5m SLO
+   burn rate across them, then ingest both as synthesized per-cell series
+   (``kukeon_scaler_queue_ratio`` / ``kukeon_scaler_burn_rate``) — the
+   same store, retention, and query surface every other signal uses.
+2. **Debounce.** A private :class:`~kukeon_tpu.obs.alerts.AlertEngine`
+   over :data:`SCALER_RULES` runs the pending->firing state machine on
+   those series. Scale decisions are therefore *held breaches*, never
+   single-tick spikes: scale-up needs pressure sustained for
+   ``for: 10s``; scale-down needs the 2-minute *maximum* ratio below the
+   idle floor for a full minute (hysteresis — growing is fast, shrinking
+   is deliberate, and the two can never flap against each other because
+   an up-rule firing vetoes the down path).
+3. **Act, one step per tick.** Scale-up starts the next parked replica on
+   its pre-partitioned chip grant (``Runner.scale_model_cell``). Scale-down
+   first drains the highest-index replica through the gateway
+   (``POST /drain`` -> wait drained, where *unreachable means drained* —
+   a replica that died mid-drain is already gone, capacity-wise) and only
+   then removes it; a drain that times out ABORTS the step (result
+   ``aborted``, retried next tick) because removing a still-serving
+   replica is exactly the lost-request hole this loop exists to prevent.
+
+Chaos contract: the ``scaler.tick`` fault point fires at the top of
+:meth:`tick`; the telemetry loop catches anything the scaler throws,
+counts it on ``kukeon_scaler_errors_total``, and carries on — a crashed
+scaler degrades to "no scaling this tick", never a wedged daemon or a
+half-removed replica (the runner persists target and statuses in one
+write, and reconcile heals a replica the crash left running).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import deque
+from typing import Callable
+
+from kukeon_tpu import faults, sanitize
+from kukeon_tpu.obs import federate as fed
+from kukeon_tpu.obs.alerts import AlertEngine, Rule
+
+log = logging.getLogger("kukeon.scaler")
+
+DRAIN_TIMEOUT_ENV = "KUKEON_SCALER_DRAIN_TIMEOUT_S"
+DEFAULT_DRAIN_TIMEOUT_S = 30.0
+
+# The serving cell's own CLI default for --max-pending, mirrored here so a
+# spec that never set maxPending still yields a meaningful pressure ratio.
+DEFAULT_MAX_PENDING = 64
+
+# The scaler's decision rules, debounced through the same state machine the
+# alert engine uses (obs/alerts.py). Evaluated against the SYNTHESIZED
+# per-cell aggregates this module ingests, so each labelset is one model
+# cell, not one replica. Severity info: these are decisions, not pages.
+SCALER_RULES: tuple[Rule, ...] = (
+    Rule(name="ScaleUpQueue",
+         expr="kukeon_scaler_queue_ratio",
+         agg="avg", window_s=30.0, op=">", threshold=0.5, for_s=10.0,
+         severity="info",
+         description="aggregate admission-queue pressure above half of "
+                     "the fleet's capacity, sustained — add a replica"),
+    Rule(name="ScaleUpBurn",
+         expr="kukeon_scaler_burn_rate",
+         agg="max", window_s=60.0, op=">", threshold=1.0, for_s=10.0,
+         severity="info",
+         description="a replica is burning SLO error budget faster than "
+                     "allowed — add a replica before the page fires"),
+    Rule(name="ScaleDownIdle",
+         expr="kukeon_scaler_queue_ratio",
+         agg="max", window_s=120.0, op="<", threshold=0.1, for_s=60.0,
+         severity="info",
+         description="even the PEAK queue pressure of the last two "
+                     "minutes is under 10% of capacity, held for a full "
+                     "minute — drain and remove a replica"),
+)
+
+_UP_RULES = ("ScaleUpQueue", "ScaleUpBurn")
+_DOWN_RULE = "ScaleDownIdle"
+
+
+def _materialize_replica(ctl, rec, target: int) -> None:
+    """Scale-up seam: bring the replica set to ``target`` by starting the
+    next parked container on its stable chip grant. Module-level so the
+    fake-backend fleet simulator can wrap it to also respawn its fake
+    replica HTTP servers (the same pattern as daemon._rollout_restart)."""
+    ctl.runner.scale_model_cell(rec.realm, rec.space, rec.stack, rec.name,
+                                target)
+
+
+def _remove_replica(ctl, rec, target: int) -> None:
+    """Scale-down seam: the victim replica is already drained; stop its
+    container and persist the lower target."""
+    ctl.runner.scale_model_cell(rec.realm, rec.space, rec.stack, rec.name,
+                                target)
+
+
+class FleetScaler:
+    """The reconcile loop over every autoscaled model cell. Owned by
+    FleetTelemetry (whose tick drives :meth:`tick` right after alert
+    evaluation, on the daemon's telemetry thread); `kuke scale` reads
+    :meth:`states` from RPC handler threads — hence the lock around the
+    decision snapshot and event ring."""
+
+    def __init__(self, ctl, tsdb, registry=None,
+                 clock: Callable[[], float] = time.time,
+                 drain_timeout_s: float | None = None,
+                 max_events: int = 128):
+        self.ctl = ctl
+        self.tsdb = tsdb
+        self._clock = clock
+        self.drain_timeout_s = (
+            drain_timeout_s if drain_timeout_s is not None
+            else float(os.environ.get(DRAIN_TIMEOUT_ENV, "")
+                       or DEFAULT_DRAIN_TIMEOUT_S))
+        # The debounce: a PRIVATE alert engine over the scaler rules (no
+        # registry — its firing census must not collide with the real
+        # alert engine's kukeon_alerts_firing; no webhook — decisions are
+        # not pages).
+        self.engine = AlertEngine(tsdb, rules=SCALER_RULES, registry=None,
+                                  clock=clock, webhook_url="")
+        self._lock = sanitize.lock("FleetScaler._lock")
+        self._events: deque[dict] = deque(maxlen=max_events)  # guarded-by: _lock
+        self._last: dict[str, dict] = {}                      # guarded-by: _lock
+
+        self._m_ticks = self._m_errors = self._m_events = None
+        self._g_desired = self._g_min = self._g_max = None
+        self._g_queue = self._g_burn = None
+        if registry is not None:
+            self._m_ticks = registry.counter(
+                "kukeon_scaler_ticks_total",
+                "FleetScaler reconcile passes completed.")
+            self._m_errors = registry.counter(
+                "kukeon_scaler_errors_total",
+                "Scaler ticks that raised (incl. the armed scaler.tick "
+                "fault point) — the loop survives and skips the tick.")
+            self._m_events = registry.counter(
+                "kukeon_scaler_events_total",
+                "Scale decisions acted on, by cell, direction, and result "
+                "(aborted = a scale-down drain timed out; the replica "
+                "stays, retried next tick).",
+                labels=("cell", "direction", "result"))
+            self._g_desired = registry.gauge(
+                "kukeon_scaler_replicas_desired",
+                "Active replica target per autoscaled cell.",
+                labels=("cell",))
+            self._g_min = registry.gauge(
+                "kukeon_scaler_replicas_min",
+                "Lower autoscale bound per cell.", labels=("cell",))
+            self._g_max = registry.gauge(
+                "kukeon_scaler_replicas_max",
+                "Upper autoscale bound per cell.", labels=("cell",))
+            self._g_queue = registry.gauge(
+                "kukeon_scaler_queue_ratio",
+                "Aggregate queue depth over active-fleet capacity "
+                "(sum(queue) / (active * max_pending)) per autoscaled "
+                "cell — the scale-up pressure signal.", labels=("cell",))
+            self._g_burn = registry.gauge(
+                "kukeon_scaler_burn_rate",
+                "Worst 5m SLO burn rate across the cell's active "
+                "replicas — the SLO-driven scale-up signal.",
+                labels=("cell",))
+
+    def note_error(self) -> None:
+        """Telemetry-loop accounting for a tick that raised."""
+        if self._m_errors is not None:
+            self._m_errors.inc()
+
+    # --- the reconcile tick -------------------------------------------------
+
+    def tick(self, at: float | None = None) -> list[dict]:
+        """One reconcile pass; returns the scale events it acted on. May
+        raise (the scaler.tick chaos seam does) — the caller's telemetry
+        loop is the survival boundary, not this method."""
+        faults.maybe_fail("scaler.tick")
+        now = self._clock() if at is None else at
+        cells = self._autoscaled_cells()
+        if self._m_ticks is not None:
+            self._m_ticks.inc()
+        if not cells:
+            with self._lock:
+                self._last = {}
+            return []
+
+        # --- sense: synthesize per-cell aggregate signals ------------------
+        signals: dict[str, dict] = {}
+        queue_rows: list[tuple[str, float]] = []
+        burn_rows: list[tuple[str, float]] = []
+        qd = self.tsdb.query("kukeon_engine_queue_depth", 60.0, "latest",
+                             at=now)
+        burn = self.tsdb.query("kukeon_slo_burn_rate", 60.0, "latest",
+                               at=now)
+        for key, rec, m in cells:
+            active = self.ctl.runner.model_target(rec)
+            active_keys = {f"{key}/r{i}" for i in range(active)}
+            qsum, have = 0.0, False
+            for labels, v in qd:
+                if labels.get("cell") in active_keys:
+                    qsum += v
+                    have = True
+            worst_burn = 0.0
+            for labels, v in burn:
+                if (labels.get("cell") in active_keys
+                        and labels.get("window") == "5m"):
+                    worst_burn = max(worst_burn, v)
+            max_pending = m.max_pending or DEFAULT_MAX_PENDING
+            ratio = qsum / max(1.0, active * max_pending)
+            lo = max(1, m.min_replicas or 1)
+            hi = m.max_replicas or lo
+            signals[key] = {
+                "cell": key, "active": active, "min": lo, "max": hi,
+                "queueRatio": round(ratio, 4),
+                "burnRate": round(worst_burn, 4),
+                "scraped": have,
+            }
+            if self._g_desired is not None:
+                self._g_desired.set(active, cell=key)
+                self._g_min.set(lo, cell=key)
+                self._g_max.set(hi, cell=key)
+                self._g_queue.set(ratio, cell=key)
+                self._g_burn.set(worst_burn, cell=key)
+            if have:
+                # No queue data means the fleet has not been scraped yet
+                # (fresh daemon, cell still booting): feeding a synthetic
+                # 0 would read as "idle" and trigger a bogus scale-down.
+                queue_rows.append((key, ratio))
+                burn_rows.append((key, worst_burn))
+        self.tsdb.ingest({
+            "kukeon_scaler_queue_ratio": fed.Family(
+                "kukeon_scaler_queue_ratio", "gauge", "",
+                [("kukeon_scaler_queue_ratio", {"cell": k}, str(v))
+                 for k, v in queue_rows]),
+            "kukeon_scaler_burn_rate": fed.Family(
+                "kukeon_scaler_burn_rate", "gauge", "",
+                [("kukeon_scaler_burn_rate", {"cell": k}, str(v))
+                 for k, v in burn_rows]),
+        }, at=now)
+
+        # --- debounce: the pending->firing machine over the signals --------
+        self.engine.evaluate(at=now)
+        firing: dict[str, set[str]] = {}
+        rule_states: dict[str, dict[str, str]] = {}
+        for row in self.engine.states():
+            cell = (row.get("labels") or {}).get("cell")
+            if cell is None:
+                continue
+            rule_states.setdefault(cell, {})[row["alert"]] = row["state"]
+            if row["state"] == "firing":
+                firing.setdefault(cell, set()).add(row["alert"])
+
+        # --- act: at most one step per cell per tick ------------------------
+        events: list[dict] = []
+        for key, rec, m in cells:
+            sig = signals[key]
+            sig["rules"] = rule_states.get(key, {})
+            lit = firing.get(key, set())
+            up = bool(lit & set(_UP_RULES))
+            down = _DOWN_RULE in lit
+            try:
+                if up and sig["active"] < sig["max"]:
+                    events.append(self._scale_up(key, rec, sig, now))
+                elif down and not up and sig["active"] > sig["min"]:
+                    events.append(self._scale_down(key, rec, m, sig, now))
+            except Exception as e:  # noqa: BLE001 — one cell must not stall the fleet
+                log.exception("scaler: %s on %s failed",
+                              "scale-up" if up else "scale-down", key)
+                if self._m_events is not None:
+                    self._m_events.inc(cell=key,
+                                       direction="up" if up else "down",
+                                       result="error")
+                events.append({"at": now, "cell": key,
+                               "direction": "up" if up else "down",
+                               "result": "error",
+                               "reason": f"{type(e).__name__}: {e}"})
+        with self._lock:
+            self._last = signals
+            for ev in events:
+                self._events.append(ev)
+        return events
+
+    def _scale_up(self, key: str, rec, sig: dict, now: float) -> dict:
+        target = sig["active"] + 1
+        _materialize_replica(self.ctl, rec, target)
+        sig["active"] = target
+        if self._m_events is not None:
+            self._m_events.inc(cell=key, direction="up", result="ok")
+        if self._g_desired is not None:
+            self._g_desired.set(target, cell=key)
+        ev = {"at": now, "cell": key, "direction": "up", "result": "ok",
+              "to": target,
+              "reason": f"queueRatio={sig['queueRatio']} "
+                        f"burn={sig['burnRate']}"}
+        log.info("scaler: %s scaled up to %d replicas (%s)", key, target,
+                 ev["reason"])
+        return ev
+
+    def _scale_down(self, key: str, rec, m, sig: dict, now: float) -> dict:
+        from kukeon_tpu.gateway import rollout as ro
+
+        victim = sig["active"] - 1
+        host = rec.status.ip or "127.0.0.1"
+        url = f"http://{host}:{m.port + 1 + victim}"
+        # Drain FIRST, remove ONLY once drained: the replica leaves the
+        # gateway's rotation the moment it reports draining, finishes its
+        # in-flight work, and exits — unreachable counts as drained (a
+        # replica that died mid-drain holds no requests to lose).
+        drained = ro.drain_replica(url, drain_timeout_s=self.drain_timeout_s)
+        if not drained:
+            if self._m_events is not None:
+                self._m_events.inc(cell=key, direction="down",
+                                   result="aborted")
+            ev = {"at": now, "cell": key, "direction": "down",
+                  "result": "aborted", "to": sig["active"],
+                  "reason": f"model-server-{victim} still serving after "
+                            f"{self.drain_timeout_s:.0f}s drain; kept"}
+            log.warning("scaler: %s scale-down aborted (%s)", key,
+                        ev["reason"])
+            return ev
+        _remove_replica(self.ctl, rec, victim)
+        sig["active"] = victim
+        if self._m_events is not None:
+            self._m_events.inc(cell=key, direction="down", result="ok")
+        if self._g_desired is not None:
+            self._g_desired.set(victim, cell=key)
+        ev = {"at": now, "cell": key, "direction": "down", "result": "ok",
+              "to": victim,
+              "reason": f"queueRatio={sig['queueRatio']} (idle)"}
+        log.info("scaler: %s scaled down to %d replicas", key, victim)
+        return ev
+
+    # --- inputs / views -----------------------------------------------------
+
+    def _autoscaled_cells(self) -> list[tuple[str, object, object]]:
+        """(cell key, typed record, ModelSpec) for every running model cell
+        with autoscale bounds."""
+        out = []
+        for realm in self.ctl.list_realms():
+            for rec_json in self.ctl.list_cells(realm):
+                m = (rec_json.get("spec") or {}).get("model") or {}
+                if not m.get("maxReplicas"):
+                    continue
+                st = rec_json.get("status") or {}
+                if st.get("phase") not in ("ready", "degraded"):
+                    continue
+                rec = self.ctl.store.read_cell(
+                    rec_json["realm"], rec_json["space"],
+                    rec_json["stack"], rec_json["name"])
+                key = "/".join((rec.realm, rec.space, rec.stack, rec.name))
+                out.append((key, rec, rec.spec.model))
+        return out
+
+    def states(self) -> list[dict]:
+        """One row per autoscaled cell — bounds, active target, the latest
+        signals, and each decision rule's debounce state (the `kuke scale`
+        table)."""
+        with self._lock:
+            return [dict(sig) for sig in self._last.values()]
+
+    def events(self, n: int = 50) -> list[dict]:
+        with self._lock:
+            return list(self._events)[-int(n):]

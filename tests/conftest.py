@@ -103,7 +103,7 @@ def _isolate_telemetry_env(monkeypatch):
     must never rewire another test's daemon."""
     for var in ("KUKEON_ALERT_RULES", "KUKEON_ALERT_WEBHOOK",
                 "KUKEON_SCRAPE_INTERVAL_S", "KUKEON_TSDB_RETENTION_S",
-                "KUKEON_TSDB_MAX_SERIES"):
+                "KUKEON_TSDB_MAX_SERIES", "KUKEON_SCALER_DRAIN_TIMEOUT_S"):
         monkeypatch.delenv(var, raising=False)
 
 

@@ -259,6 +259,126 @@ def test_webhook_posts_transitions():
         srv.server_close()
 
 
+def test_webhook_retries_once_then_delivers(monkeypatch):
+    """Satellite: one bounded retry with backoff — a single dropped POST
+    must not lose a page. The alerts.webhook fault point fails exactly the
+    first attempt; the retry delivers and is counted result=retried."""
+    import os
+
+    from kukeon_tpu import faults
+    from kukeon_tpu.obs import alerts as alerts_mod
+
+    got: list[dict] = []
+
+    class Hook(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            got.append(json.loads(body))
+            self.send_response(200)
+            self.end_headers()
+
+    monkeypatch.setattr(alerts_mod, "WEBHOOK_RETRY_BACKOFF_S", 0.05)
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Hook)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        now = [0.0]
+        reg = Registry()
+        rule = Rule(name="G", expr="kukeon_g", agg="latest", window_s=60,
+                    op=">", threshold=5, for_s=0)
+        db, eng = _engine(
+            rule, lambda: now[0], registry=reg,
+            webhook=f"http://127.0.0.1:{srv.server_address[1]}/hook")
+        os.environ[faults.ENV] = "alerts.webhook:1:1"   # first attempt only
+        db.ingest(_fam("kukeon_g", "gauge", ({"cell": "a"}, 9)), at=0)
+        eng.evaluate(at=0)
+        deadline = time.monotonic() + 5
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert got and got[0]["alert"] == "G"
+        assert faults.fired("alerts.webhook") == 1
+        deadline = time.monotonic() + 5
+        while (reg.get("kukeon_alerts_webhook_total").value(result="retried")
+               < 1 and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert reg.get("kukeon_alerts_webhook_total").value(
+            result="retried") == 1
+        assert reg.get("kukeon_alerts_webhook_total").value(result="ok") == 0
+        assert reg.get("kukeon_alerts_webhook_total").value(
+            result="error") == 0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_webhook_both_attempts_fail_counts_error(monkeypatch):
+    import os
+
+    from kukeon_tpu import faults
+    from kukeon_tpu.obs import alerts as alerts_mod
+
+    monkeypatch.setattr(alerts_mod, "WEBHOOK_RETRY_BACKOFF_S", 0.05)
+    now = [0.0]
+    reg = Registry()
+    rule = Rule(name="G", expr="kukeon_g", agg="latest", window_s=60,
+                op=">", threshold=5, for_s=0)
+    db, eng = _engine(rule, lambda: now[0], registry=reg,
+                      webhook="http://127.0.0.1:1/hook")
+    os.environ[faults.ENV] = "alerts.webhook"           # every attempt
+    db.ingest(_fam("kukeon_g", "gauge", ({"cell": "a"}, 9)), at=0)
+    eng.evaluate(at=0)
+    deadline = time.monotonic() + 5
+    while (reg.get("kukeon_alerts_webhook_total").value(result="error") < 1
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    assert reg.get("kukeon_alerts_webhook_total").value(result="error") == 1
+    assert faults.fired("alerts.webhook") == 2          # attempt + retry
+
+
+def test_cmd_alerts_check_exit_codes(monkeypatch, capsys):
+    """Satellite: `kuke alerts --check` is a health gate — 0 quiet,
+    1 while anything is firing, 2 on a broken user-rules file."""
+    from kukeon_tpu.runtime import cli
+
+    payload = {"alerts": [
+        {"alert": "SloBurnFast", "severity": "critical", "state": "ok",
+         "expr": "e", "threshold": 1, "description": ""}],
+        "transitions": []}
+
+    class _Client:
+        def call(self, method, **params):
+            assert method == "Alerts"
+            return payload
+
+    monkeypatch.setattr(cli, "_client", lambda args: _Client())
+
+    def run(check=True, as_json=False):
+        return cli.cmd_alerts(argparse.Namespace(
+            json=as_json, transitions=50, check=check))
+
+    assert run() == 0
+    assert "fleet healthy" in capsys.readouterr().out
+    payload["alerts"][0]["state"] = "firing"
+    payload["alerts"][0].update({"value": 12.0, "since": 0.0,
+                                 "labels": {"cell": "a"}})
+    assert run() == 1
+    assert "SloBurnFast" in capsys.readouterr().err
+    assert run(as_json=True) == 1
+    capsys.readouterr()
+    payload["alerts"][0]["state"] = "ok"
+    for k in ("value", "since", "labels"):
+        payload["alerts"][0].pop(k)
+    payload["rulesError"] = "rule 'broken' is missing field 'expr'"
+    assert run() == 2
+    assert run(as_json=True) == 2
+    capsys.readouterr()
+    # Without --check the verb stays informational: always 0.
+    assert run(check=False) == 0
+    capsys.readouterr()
+
+
 # --- the fake-backend fleet --------------------------------------------------
 
 

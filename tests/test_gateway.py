@@ -370,8 +370,11 @@ def test_prefix_affinity_sticks_through_the_gateway():
 
 
 def test_retry_on_shedding_replica_then_passthrough_when_all_shed():
+    # spill_capacity=0: this test pins the PASSTHROUGH contract (what an
+    # all-shed storm degrades to when the spillover queue is full); the
+    # spillover queue itself is covered in tests/test_scaler.py.
     a, b = FakeReplica(), FakeReplica()
-    gw, port = _gateway([a, b])
+    gw, port = _gateway([a, b], spill_capacity=0)
     try:
         # Aim at a prefix whose home is r0, then make r0 shed.
         sess = next(p for p in (f"s{i}" for i in range(64))
@@ -417,8 +420,10 @@ def test_draining_replica_leaves_rotation_and_503_retries():
 
 
 def test_no_replica_available_sheds_503_with_retry_after():
+    # spill_capacity=0 pins the terminal 503 shape (see the spillover
+    # suite in tests/test_scaler.py for the parking behavior).
     a, b = FakeReplica(), FakeReplica()
-    gw, port = _gateway([a, b])
+    gw, port = _gateway([a, b], spill_capacity=0)
     try:
         a.ready = False
         b.ready = False
@@ -957,7 +962,7 @@ def _load_bench():
     return mod
 
 
-def test_bench_artifact_v4_and_backcompat(tmp_path):
+def test_bench_artifact_v5_and_backcompat(tmp_path):
     bench = _load_bench()
     serve = {"backend": "cpu", "n_chips": 1, "model": "tiny",
              "model_id": "tiny", "sessions": 4, "tok_per_s": 100.0,
@@ -967,24 +972,26 @@ def test_bench_artifact_v4_and_backcompat(tmp_path):
     out = tmp_path / "BENCH_rXX.json"
     bench.write_artifact(str(out), serve,
                          {"vs_baseline": 0.5, "handoff_ms_p50": 12.5,
-                          "disagg": {"arms": {}}})
+                          "disagg": {"arms": {}},
+                          "diurnal": {"peak_p95_s": 0.8, "failed": 0}})
     art = bench.read_artifact(str(out))
-    assert art["schema"] == "kukeon-bench/v4"
+    assert art["schema"] == "kukeon-bench/v5"
     assert art["replicas"] == 3
     assert art["kv_page_tokens"] == 16
     assert art["max_sessions"] == 9
     assert art["ttft_p95_s"] == 0.25
     assert art["handoff_ms_p50"] == 12.5
     assert art["disagg"] == {"arms": {}}
+    assert art["diurnal"] == {"peak_p95_s": 0.8, "failed": 0}
 
-    # A v1 point (pre-gateway, single engine) reads back as v4: replicas=1,
+    # A v1 point (pre-gateway, single engine) reads back as v5: replicas=1,
     # legacy contiguous KV (kv_page_tokens=0), every session resident, no
-    # handoff (none existed).
+    # handoff and no diurnal section (neither existed).
     v1 = tmp_path / "BENCH_r05.json"
     v1.write_text(json.dumps({"schema": "kukeon-bench/v1", "backend": "cpu",
                               "tok_per_s": 50.0, "sessions": 4}))
     art = bench.read_artifact(str(v1))
-    assert art["schema"] == "kukeon-bench/v4"
+    assert art["schema"] == "kukeon-bench/v5"
     assert art["replicas"] == 1
     assert art["tok_per_s"] == 50.0
     assert art["kv_page_tokens"] == 0
@@ -992,6 +999,7 @@ def test_bench_artifact_v4_and_backcompat(tmp_path):
     assert art["ttft_p95_s"] is None
     assert art["handoff_ms_p50"] is None
     assert art["disagg"] is None
+    assert art["diurnal"] is None
 
     # A v2 point (pre-paged-KV) keeps its replicas and gains the later
     # fields; its TTFT p95 lifts from the latency percentiles it recorded.
@@ -1001,23 +1009,39 @@ def test_bench_artifact_v4_and_backcompat(tmp_path):
                               "replicas": 2,
                               "latency_s": {"ttft": {"p95": 0.4}}}))
     art = bench.read_artifact(str(v2))
-    assert art["schema"] == "kukeon-bench/v4"
+    assert art["schema"] == "kukeon-bench/v5"
     assert art["replicas"] == 2
     assert art["kv_page_tokens"] == 0
     assert art["max_sessions"] == 2
     assert art["ttft_p95_s"] == 0.4
 
-    # A v3 point (pre-disaggregation) gains only the v4 fields.
+    # A v3 point (pre-disaggregation) gains the v4 and v5 fields.
     v3 = tmp_path / "BENCH_r07.json"
     v3.write_text(json.dumps({"schema": "kukeon-bench/v3", "backend": "cpu",
                               "tok_per_s": 70.0, "sessions": 2,
                               "replicas": 1, "kv_page_tokens": 16,
                               "max_sessions": 4}))
     art = bench.read_artifact(str(v3))
-    assert art["schema"] == "kukeon-bench/v4"
+    assert art["schema"] == "kukeon-bench/v5"
     assert art["kv_page_tokens"] == 16
     assert art["max_sessions"] == 4
     assert art["handoff_ms_p50"] is None
+    assert art["diurnal"] is None
+
+    # A v4 point (pre-autoscaling) gains only the diurnal section.
+    v4 = tmp_path / "BENCH_r08.json"
+    v4.write_text(json.dumps({"schema": "kukeon-bench/v4", "backend": "cpu",
+                              "tok_per_s": 80.0, "sessions": 2,
+                              "replicas": 2, "kv_page_tokens": 16,
+                              "max_sessions": 4, "ttft_p95_s": 0.3,
+                              "handoff_ms_p50": 10.0,
+                              "disagg": {"arms": {}}}))
+    art = bench.read_artifact(str(v4))
+    assert art["schema"] == "kukeon-bench/v5"
+    assert art["ttft_p95_s"] == 0.3
+    assert art["handoff_ms_p50"] == 10.0
+    assert art["disagg"] == {"arms": {}}
+    assert art["diurnal"] is None
 
     bad = tmp_path / "BENCH_bad.json"
     bad.write_text(json.dumps({"schema": "nope/v9"}))

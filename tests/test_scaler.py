@@ -1,0 +1,832 @@
+"""Self-healing fleet (ISSUE 12): SLO-driven autoscaling (FleetScaler),
+gateway spillover, and the chaos contract around both.
+
+Everything runs against fakes over real HTTP, the same philosophy as the
+gateway/rollout suites: replica failure is scripted, never timed; the
+scaler's clock is injectable so a two-minute hysteresis window costs
+milliseconds of wall time; and the acceptance spine is the diurnal ramp —
+traffic triples, replicas grow min->max, scale-down drains with zero lost
+requests, and `kuke alerts --check` stays quiet throughout."""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kukeon_tpu import faults, obs
+from kukeon_tpu.gateway.cell import GatewayCell, make_gateway_handler
+from kukeon_tpu.obs import Registry, expo
+from kukeon_tpu.runtime import scaler as scaler_mod
+from kukeon_tpu.runtime.api import types as t
+from kukeon_tpu.runtime.cells import FakeBackend
+from kukeon_tpu.runtime.controller import Controller
+from kukeon_tpu.runtime.daemon import FleetTelemetry, RPCService
+from kukeon_tpu.runtime.devices import TPUDeviceManager
+from kukeon_tpu.runtime.errors import InvalidArgument
+from kukeon_tpu.runtime.metadata import MetadataStore
+from kukeon_tpu.runtime.runner import Runner, RunnerOptions
+from kukeon_tpu.runtime.store import ResourceStore
+
+from test_gateway import FakeReplica, _free_port_block, _gateway, _post, _teardown
+
+
+# --- the simulated replica ---------------------------------------------------
+
+
+class SimReplica:
+    """A model-serving replica for the fleet simulator: the full surface
+    the gateway, the rollout machinery, AND the telemetry scrape consume —
+    /v1/generate, /v1/stats, /readyz, /drain, plus a real /metrics backed
+    by a Registry whose queue-depth and SLO-burn gauges the test scripts
+    (the scaler's sensors read these through the daemon's own scrape
+    path, so the loop under test is the production one end to end)."""
+
+    def __init__(self, port: int = 0, max_pending: int = 10,
+                 delay_s: float = 0.0, drainable: bool = True):
+        self.queue_depth = 0.0
+        self.burn = 0.2               # 5m SLO burn; well under SloBurnFast
+        self.max_pending = max_pending
+        self.delay_s = delay_s
+        self.drainable = drainable    # False = never reports drained
+        self.ready = True
+        self.draining = False
+        self.drained = False
+        self.shed_429 = False
+        self.requests = 0
+        self.inflight = 0
+        self._lock = threading.Lock()
+
+        reg = Registry()
+        reg.gauge("kukeon_cell_ready", "ready").set_function(
+            lambda: 1.0 if self.ready and not self.draining else 0.0)
+        reg.gauge("kukeon_engine_queue_depth", "queue").set_function(
+            lambda: float(self.queue_depth))
+        reg.gauge("kukeon_engine_max_pending", "cap").set(max_pending)
+        reg.gauge("kukeon_slo_burn_rate", "burn",
+                  labels=("slo", "window")).set_function(
+            lambda: float(self.burn), slo="availability", window="5m")
+        self.registry = reg
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *a):
+                pass
+
+            def _json(self, code, obj, headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = expo.render(outer.registry).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", expo.CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/readyz":
+                    ok = outer.ready and not outer.draining
+                    self._json(200 if ok else 503, {"ready": ok})
+                elif self.path == "/v1/stats":
+                    self._json(200, outer.stats())
+                elif self.path in ("/healthz", "/v1/health"):
+                    self._json(200, {"status": "ok"})
+                else:
+                    self._json(404, {"error": self.path})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                if self.path == "/drain":
+                    self._json(200, {"draining": True,
+                                     "started": outer.begin_drain()})
+                    return
+                if self.path != "/v1/generate":
+                    self._json(404, {"error": self.path})
+                    return
+                if outer.draining or not outer.ready:
+                    self._json(503, {"error": "draining"},
+                               {"Retry-After": "1"})
+                    return
+                if outer.shed_429:
+                    self._json(429, {"error": "queue full"},
+                               {"Retry-After": "1"})
+                    return
+                with outer._lock:
+                    outer.requests += 1
+                    outer.inflight += 1
+                try:
+                    if outer.delay_s:
+                        time.sleep(outer.delay_s)
+                    self._json(200, {"tokens": [1, 2], "text": "ab",
+                                     "numTokens": 2, "seconds": 0.0})
+                finally:
+                    with outer._lock:
+                        outer.inflight -= 1
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stats(self) -> dict:
+        drained = (self.drainable and self.draining
+                   and not self.inflight)
+        return {"model": "tiny",
+                "ready": self.ready and not self.draining,
+                "draining": self.draining and self.drainable,
+                "queueDepth": 0 if self.draining else int(self.queue_depth),
+                "inflight": self.inflight,
+                "drained": drained}
+
+    def begin_drain(self) -> bool:
+        if not self.drainable:
+            return False      # scripted wedge: admits forever, never drains
+        if self.draining:
+            return False
+        self.draining = True
+
+        def _loop():
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and self.inflight:
+                time.sleep(0.02)
+            self.drained = True
+            self.kill()
+
+        threading.Thread(target=_loop, daemon=True).start()
+        return True
+
+    def kill(self) -> None:
+        try:
+            self.server.shutdown()
+            self.server.server_close()
+        except OSError:
+            pass
+
+
+# --- controller fixtures -----------------------------------------------------
+
+
+def _controller(tmp_path):
+    store = ResourceStore(MetadataStore(str(tmp_path)))
+    backend = FakeBackend()
+    runner = Runner(store, backend, cgroups=None,
+                    devices=TPUDeviceManager(store.ms, chips=[0, 1, 2, 3]),
+                    options=RunnerOptions(stop_grace_s=0.2),
+                    registry=obs.Registry())
+    ctl = Controller(store, runner)
+    ctl.bootstrap()
+    return ctl, backend, store
+
+
+def _autoscaled_doc(port: int, replicas=1, mn=1, mx=3,
+                    max_pending=10) -> t.Document:
+    return t.Document(
+        kind=t.KIND_CELL, metadata=t.Metadata(name="llm"),
+        spec=t.CellSpec(model=t.ModelSpec(
+            model="tiny", chips=1, port=port, replicas=replicas,
+            min_replicas=mn, max_replicas=mx, max_pending=max_pending)))
+
+
+KEY = "default/default/default/llm"
+
+
+# --- runner: bound materialization + parked replicas -------------------------
+
+
+def test_runner_materializes_bound_and_parks_above_target(tmp_path):
+    ctl, backend, store = _controller(tmp_path)
+    ctl.create_cell(_autoscaled_doc(9300))
+    started = {c.spec.name for c in backend.started}
+    # Only the active replica and the gateway START...
+    assert started == {"model-server-0", "gateway"}
+    # ...but the FULL bound is materialized: the gateway knows every
+    # replica URL, and the chip partition covers all three replicas so a
+    # later scale-up starts replica i on exactly its chips.
+    gcmd = next(c for c in backend.started
+                if c.spec.name == "gateway").command
+    assert [u for f, u in zip(gcmd, gcmd[1:]) if f == "--replica"] == [
+        "http://127.0.0.1:9301", "http://127.0.0.1:9302",
+        "http://127.0.0.1:9303"]
+    rec = store.read_cell("default", "default", "default", "llm")
+    assert rec.status.tpu_chips == [0, 1, 2]
+    # Parked replicas do not count against readiness.
+    assert rec.status.phase == "ready"
+    assert {c.name for c in rec.status.containers} == {
+        "model-server-0", "model-server-1", "model-server-2", "gateway"}
+
+
+def test_scale_model_cell_up_down_and_reconcile_respects_parked(tmp_path):
+    ctl, backend, store = _controller(tmp_path)
+    ctl.create_cell(_autoscaled_doc(9300))
+    runner = ctl.runner
+
+    rec = runner.scale_model_cell("default", "default", "default", "llm", 3)
+    assert rec.status.target_replicas == 3
+    started = {c.spec.name for c in backend.started}
+    assert {"model-server-1", "model-server-2"} <= started
+    # Replica i came back on ITS deterministic chip.
+    by_name = {c.spec.name: c for c in backend.started}
+    assert by_name["model-server-1"].env["TPU_VISIBLE_DEVICES"] == "1"
+    assert by_name["model-server-2"].env["TPU_VISIBLE_DEVICES"] == "2"
+    assert rec.status.phase == "ready"
+
+    rec = runner.scale_model_cell("default", "default", "default", "llm", 1)
+    assert rec.status.target_replicas == 1
+    assert rec.status.container("model-server-1").state == "exited"
+    assert rec.status.container("model-server-2").state == "exited"
+    assert rec.status.phase == "ready"   # parked exits are not failures
+
+    # The reconcile loop must NOT tug against the scaler: a scaled-down
+    # replica stays down across refresh passes.
+    for _ in range(2):
+        _rec, outcome = runner.refresh_cell("default", "default", "default",
+                                            "llm")
+        assert outcome in ("steady", "healed")
+    rec = store.read_cell("default", "default", "default", "llm")
+    assert rec.status.container("model-server-1").state == "exited"
+
+    with pytest.raises(InvalidArgument, match="outside"):
+        runner.scale_model_cell("default", "default", "default", "llm", 4)
+    with pytest.raises(InvalidArgument, match="outside"):
+        runner.scale_model_cell("default", "default", "default", "llm", 0)
+
+
+# --- the scaler's debounce + hysteresis --------------------------------------
+
+
+class _Clock:
+    def __init__(self, at=1_000_000.0):
+        self.now = at
+
+    def __call__(self):
+        return self.now
+
+
+def _scaler_rig(tmp_path, monkeypatch, port=9300, mx=3):
+    """Controller + autoscaled cell + a clock-driven FleetScaler whose
+    sensors are fed by direct TSDB ingest (no HTTP; the full-HTTP loop is
+    the acceptance sim below). Scale-downs drain against dead ports —
+    unreachable-means-drained, so they complete instantly."""
+    from kukeon_tpu.obs import federate as fed
+    from kukeon_tpu.obs.tsdb import TSDB
+
+    ctl, backend, store = _controller(tmp_path)
+    ctl.create_cell(_autoscaled_doc(port, mx=mx))
+    clock = _Clock()
+    tsdb = TSDB(clock=clock)
+    sc = scaler_mod.FleetScaler(ctl, tsdb, registry=ctl.runner.registry,
+                                clock=clock, drain_timeout_s=1.0)
+
+    def feed(queue_per_replica: float):
+        """Ingest one scrape's worth of per-replica queue depth for the
+        ACTIVE replicas (what the telemetry loop would have scraped)."""
+        rec = store.read_cell("default", "default", "default", "llm")
+        active = ctl.runner.model_target(rec)
+        fam = fed.Family(
+            "kukeon_engine_queue_depth", "gauge", "",
+            [("kukeon_engine_queue_depth", {"cell": f"{KEY}/r{i}"},
+              str(queue_per_replica)) for i in range(active)])
+        tsdb.ingest({"kukeon_engine_queue_depth": fam}, at=clock.now)
+
+    def tick(queue_per_replica: float, dt: float = 10.0):
+        clock.now += dt
+        feed(queue_per_replica)
+        return sc.tick(at=clock.now)
+
+    return ctl, store, sc, clock, tick
+
+
+def test_scaler_debounces_scale_up_and_steps_to_max(tmp_path, monkeypatch):
+    ctl, store, sc, clock, tick = _scaler_rig(tmp_path, monkeypatch)
+
+    # First breaching tick: PENDING, not acted on — a one-tick spike must
+    # never add a replica.
+    assert tick(9.0) == []
+    # Held for the for: duration -> firing -> one step up.
+    evs = tick(9.0)
+    assert [(e["direction"], e["result"], e["to"]) for e in evs] == [
+        ("up", "ok", 2)]
+    rec = store.read_cell("default", "default", "default", "llm")
+    assert ctl.runner.model_target(rec) == 2
+    # Pressure persists (per-replica queue still deep): keep growing, one
+    # step per tick, and STOP at the bound.
+    assert [e["to"] for e in tick(9.0)] == [3]
+    assert tick(9.0) == []          # at maxReplicas: firing but capped
+    assert ctl.runner.model_target(
+        store.read_cell("default", "default", "default", "llm")) == 3
+    states = {s["cell"]: s for s in sc.states()}
+    assert states[KEY]["active"] == 3
+    assert states[KEY]["rules"]["ScaleUpQueue"] == "firing"
+
+
+def test_scaler_scale_down_is_hysteretic_and_respects_min(tmp_path,
+                                                          monkeypatch):
+    ctl, store, sc, clock, tick = _scaler_rig(tmp_path, monkeypatch)
+    # Grow to max first.
+    tick(9.0)
+    tick(9.0)
+    tick(9.0)
+    assert ctl.runner.model_target(
+        store.read_cell("default", "default", "default", "llm")) == 3
+
+    # Idle traffic: the down rule needs the 2-minute PEAK under the floor
+    # held for a minute — the recent high-pressure samples block it, so
+    # the first ~18 idle ticks must produce zero scale-downs (hysteresis:
+    # no flap right after a storm).
+    downs = []
+    for i in range(30):
+        downs += [(i, e) for e in tick(0.0)]
+        if downs:
+            break
+    assert downs, "scale-down never happened"
+    first_i, first = downs[0]
+    assert first_i >= 17, f"scale-down after only {first_i + 1} idle ticks"
+    assert (first["direction"], first["result"], first["to"]) == \
+        ("down", "ok", 2)
+    # Keeps shrinking one step per tick down to minReplicas, never below.
+    evs = tick(0.0)
+    assert [e["to"] for e in evs] == [1]
+    for _ in range(3):
+        assert tick(0.0) == []
+    assert ctl.runner.model_target(
+        store.read_cell("default", "default", "default", "llm")) == 1
+    # The drained victims really stopped.
+    rec = store.read_cell("default", "default", "default", "llm")
+    assert rec.status.container("model-server-2").state == "exited"
+    assert rec.status.container("model-server-1").state == "exited"
+
+
+def test_scale_down_aborts_when_victim_will_not_drain(tmp_path,
+                                                      monkeypatch):
+    """A replica that keeps serving past the drain timeout is KEPT (result
+    aborted, retried next tick) — removing it would lose its in-flight
+    requests, the exact hole the drain-first order exists to prevent."""
+    base = _free_port_block(3)
+    ctl, store, sc, clock, tick = _scaler_rig(tmp_path, monkeypatch,
+                                              port=base, mx=2)
+    sc.drain_timeout_s = 0.4
+    tick(9.0)
+    tick(9.0)        # -> active 2 (the bound)
+    assert ctl.runner.model_target(
+        store.read_cell("default", "default", "default", "llm")) == 2
+    # The victim (replica index 1) answers HTTP but never drains.
+    stuck = SimReplica(port=base + 2, drainable=False)
+    try:
+        downs = []
+        for _ in range(30):
+            downs += [e for e in tick(0.0) if e["direction"] == "down"]
+            if downs:
+                break
+        assert downs and downs[0]["result"] == "aborted"
+        assert "still serving" in downs[0]["reason"]
+        # Capacity was NOT holed: target unchanged, container untouched.
+        rec = store.read_cell("default", "default", "default", "llm")
+        assert ctl.runner.model_target(rec) == 2
+        ev_m = ctl.runner.registry.get("kukeon_scaler_events_total")
+        assert ev_m.value(cell=KEY, direction="down", result="aborted") >= 1
+    finally:
+        stuck.kill()
+
+
+def test_scaler_tick_chaos_degrades_never_wedges(tmp_path):
+    """The scaler.tick fault point armed: every telemetry tick still
+    completes (alerts evaluated, scrape health recorded), the crash is
+    counted, and no scaling happens — a dead scaler is a no-op, not a
+    dead daemon."""
+    ctl, backend, store = _controller(tmp_path)
+    ctl.create_cell(_autoscaled_doc(9300))
+    clock = _Clock()
+    telem = FleetTelemetry(ctl, clock=clock)
+    os.environ[faults.ENV] = "scaler.tick"
+    for _ in range(3):
+        clock.now += 10
+        telem.tick()          # must not raise
+    assert faults.fired("scaler.tick") == 3
+    reg = ctl.runner.registry
+    assert reg.get("kukeon_scaler_errors_total").value() == 3
+    assert reg.get("kukeon_daemon_scrape_ticks_total").value() == 3
+    rec = store.read_cell("default", "default", "default", "llm")
+    assert rec.status.target_replicas is None      # fleet untouched
+
+
+# --- gateway spillover -------------------------------------------------------
+
+
+def test_spillover_absorbs_all_shed_storm_zero_429(monkeypatch):
+    """Acceptance: every replica sheds for a brief storm; parked requests
+    all complete 200 once a replica frees — the client never sees a 429."""
+    a, b = FakeReplica(), FakeReplica()
+    a.shed_429 = True
+    b.shed_429 = True
+    gw, port = _gateway([a, b])
+    statuses: list[int] = []
+    lock = threading.Lock()
+
+    def req(i: int):
+        status, _raw, _ = _post(port, "/v1/generate",
+                                {"prompt": "x", "deadlineS": 20}, timeout=30)
+        with lock:
+            statuses.append(status)
+
+    try:
+        threads = [threading.Thread(target=req, args=(i,)) for i in range(6)]
+        for th in threads:
+            th.start()
+        time.sleep(0.4)                    # the storm
+        b.shed_429 = False                 # capacity returns
+        for th in threads:
+            th.join(timeout=30)
+        assert not any(th.is_alive() for th in threads), "request hung"
+        assert statuses == [200] * 6, statuses
+        reg = gw.registry
+        assert reg.get("kukeon_gateway_spill_total").value(
+            outcome="recovered") == 6
+        assert reg.get("kukeon_gateway_spill_total").value(
+            outcome="timeout") == 0
+        # The spill wait is visible as latency, and the spans carry the
+        # park/resume story.
+        _counts, _total, n = reg.get(
+            "kukeon_gateway_spill_wait_seconds").snapshot()
+        assert n == 6
+        spans = gw.tracer.recent(20)
+        parked = [s for s in spans
+                  if any(e["event"] == "spill_park"
+                         for e in s.get("events", []))]
+        assert parked and any(
+            e["event"] == "spill_resume" for e in parked[0]["events"])
+    finally:
+        _teardown(gw, a, b)
+
+
+def test_spillover_timeout_is_in_band(monkeypatch):
+    """Past the request deadline the gateway answers the timeout terminal
+    itself: 504 + timedOut for a plain request, a 200 ndjson terminal line
+    for a stream — mirroring the serving cell's deadline contract."""
+    a = FakeReplica()
+    a.shed_429 = True
+    gw, port = _gateway([a])
+    try:
+        t0 = time.monotonic()
+        status, raw, _ = _post(port, "/v1/generate",
+                               {"prompt": "x", "deadlineS": 0.4}, timeout=30)
+        assert status == 504
+        assert json.loads(raw)["timedOut"] is True
+        assert time.monotonic() - t0 >= 0.35
+        status, raw, headers = _post(
+            port, "/v1/generate",
+            {"prompt": "x", "deadlineS": 0.4, "stream": True}, timeout=30)
+        assert status == 200
+        assert headers["Content-Type"] == "application/x-ndjson"
+        lines = [json.loads(x) for x in raw.decode().splitlines()]
+        assert lines == [{"error": lines[0]["error"], "timedOut": True,
+                          "numTokens": 0}]
+        assert gw.registry.get("kukeon_gateway_spill_total").value(
+            outcome="timeout") == 2
+    finally:
+        _teardown(gw, a)
+
+
+def test_spillover_overflow_and_fault_degrade_to_passthrough(monkeypatch):
+    """A full spill queue (capacity 0 here) and the armed gateway.spill
+    fault point both degrade to the pre-spillover contract: the replica's
+    429 passes through with its Retry-After — immediately, never a hang."""
+    a = FakeReplica()
+    a.shed_429 = True
+    gw, port = _gateway([a], spill_capacity=0)
+    try:
+        t0 = time.monotonic()
+        status, _raw, headers = _post(port, "/v1/generate",
+                                      {"prompt": "x"}, timeout=10)
+        assert status == 429 and "Retry-After" in headers
+        assert time.monotonic() - t0 < 2.0
+        assert gw.registry.get("kukeon_gateway_spill_total").value(
+            outcome="overflow") == 1
+    finally:
+        _teardown(gw, a)
+    # Chaos seam: spillover itself failing must not take requests with it.
+    b = FakeReplica()
+    b.shed_429 = True
+    gw2, port2 = _gateway([b])
+    try:
+        os.environ[faults.ENV] = "gateway.spill"
+        status, _raw, headers = _post(port2, "/v1/generate",
+                                      {"prompt": "x"}, timeout=10)
+        assert status == 429 and "Retry-After" in headers
+        assert faults.fired("gateway.spill") == 1
+        assert gw2.registry.get("kukeon_gateway_spill_total").value(
+            outcome="fault") == 1
+    finally:
+        os.environ.pop(faults.ENV, None)
+        _teardown(gw2, b)
+
+
+# --- rollout abort summary (satellite) ---------------------------------------
+
+
+def test_rollout_abort_carries_per_step_outcomes(tmp_path, monkeypatch):
+    """An aborted rollout names which replicas finished and which one
+    stalled — through rolling_restart's RolloutError.results, the
+    RolloutCell RPC payload, and the CLI output — so it is resumable by
+    hand instead of a mystery."""
+    from kukeon_tpu.runtime import cli
+    from kukeon_tpu.runtime import daemon as dmod
+
+    ctl, backend, store = _controller(tmp_path)
+    base = _free_port_block(3)
+    ctl.create_cell(t.Document(
+        kind=t.KIND_CELL, metadata=t.Metadata(name="llm"),
+        spec=t.CellSpec(model=t.ModelSpec(model="tiny", chips=1,
+                                          replicas=2, port=base))))
+    replicas = {0: FakeReplica(port=base + 1), 1: FakeReplica(port=base + 2)}
+    real_restart = dmod._rollout_restart
+
+    def restart_and_respawn(ctl_, rec, cname):
+        i = int(cname.rsplit("-", 1)[1])
+        replicas[i].kill()
+        real_restart(ctl_, rec, cname)
+        if i == 0:
+            replicas[i] = FakeReplica(port=base + 1 + i)
+        # replica 1 never comes back: the rollout must stop there.
+
+    monkeypatch.setattr(dmod, "_rollout_restart", restart_and_respawn)
+    service = dmod.RPCService(ctl)
+    out = service.RolloutCell("default", "default", "default", "llm",
+                              drainTimeoutS=5.0, readyTimeoutS=0.8)
+    try:
+        assert out["aborted"] is True
+        assert "model-server-1" in out["error"]
+        assert [r["replica"] for r in out["replicas"]] == [
+            "model-server-0", "model-server-1"]
+        assert "readyS" in out["replicas"][0]          # finished cleanly
+        assert "not ready" in out["replicas"][1]["error"]
+
+        class _Client:
+            def call(self, method, **params):
+                assert method == "RolloutCell"
+                return out
+
+        monkeypatch.setattr(cli, "_client", lambda args: _Client())
+        args = argparse.Namespace(name="llm", json=False, realm=None,
+                                  space=None, stack=None, drain_timeout=5.0,
+                                  ready_timeout=0.8)
+        assert cli.cmd_rollout(args) == 1
+    finally:
+        for r in replicas.values():
+            r.kill()
+
+
+# --- the acceptance spine: diurnal ramp through the full loop ----------------
+
+
+class _Sim:
+    """The fake-backend fleet simulator: an autoscaled model cell whose
+    replica HTTP servers are SimReplicas, fronted by a REAL GatewayCell
+    (spillover included), sensed and scaled by a REAL FleetTelemetry +
+    FleetScaler on an injectable clock. The only fake is the backend under
+    the containers and the load model that sets each replica's queue
+    gauge; every byte of the sense->debounce->act loop is production
+    code."""
+
+    def __init__(self, tmp_path, monkeypatch):
+        self.base = _free_port_block(4)
+        self.ctl, self.backend, self.store = _controller(tmp_path)
+        self.ctl.create_cell(_autoscaled_doc(self.base, max_pending=10))
+        self.sims: dict[int, SimReplica] = {0: SimReplica(port=self.base + 1)}
+
+        real_mat = scaler_mod._materialize_replica
+
+        def mat_and_spawn(ctl_, rec, target):
+            real_mat(ctl_, rec, target)
+            i = target - 1
+            self.sims[i] = SimReplica(port=self.base + 1 + i)
+
+        monkeypatch.setattr(scaler_mod, "_materialize_replica",
+                            mat_and_spawn)
+
+        self.gw = GatewayCell(
+            "tiny", [f"http://127.0.0.1:{self.base + 1 + i}"
+                     for i in range(3)],
+            poll_interval_s=0.05, request_timeout_s=30.0)
+        self.gw.start()
+        self.gw_srv = ThreadingHTTPServer(
+            ("127.0.0.1", self.base), make_gateway_handler(self.gw))
+        threading.Thread(target=self.gw_srv.serve_forever,
+                         daemon=True).start()
+        self.gw.router.poll_once()
+
+        self.svc = RPCService(self.ctl)
+        self.clock = _Clock()
+        self.svc.telemetry = FleetTelemetry(self.ctl, clock=self.clock)
+        self.telem = self.svc.telemetry
+        self.transitions: list[dict] = []
+        self.scale_events: list[dict] = []
+
+    def active(self) -> int:
+        rec = self.store.read_cell("default", "default", "default", "llm")
+        return self.ctl.runner.model_target(rec)
+
+    def tick(self, demand: float, dt: float = 10.0) -> None:
+        """One scrape interval: the load model spreads `demand` queued
+        requests over the live replicas, then the daemon ticks (scrape ->
+        ingest -> alerts -> scaler)."""
+        self.clock.now += dt
+        active = self.active()
+        per = min(10.0, demand / max(1, active))
+        for i, sim in self.sims.items():
+            sim.queue_depth = per if i < active else 0.0
+        n_events = len(self.telem.scaler.events(1000))
+        self.transitions += self.telem.tick()
+        self.scale_events += self.telem.scaler.events(1000)[n_events:]
+
+    def close(self):
+        self.gw_srv.shutdown()
+        self.gw_srv.server_close()
+        self.gw.stop()
+        for sim in self.sims.values():
+            sim.kill()
+
+
+@pytest.fixture
+def sim(tmp_path, monkeypatch):
+    s = _Sim(tmp_path, monkeypatch)
+    yield s
+    s.close()
+
+
+def test_acceptance_diurnal_ramp(sim, monkeypatch, capsys):
+    """ISSUE 12 acceptance: traffic triples -> replicas grow min->max ->
+    SLO burn stays under the firing threshold -> scale-down drains with
+    zero lost requests -> `kuke alerts --check` exits 0 throughout."""
+    from kukeon_tpu.runtime import cli
+
+    # Night: modest steady load, fleet stays at min.
+    for _ in range(4):
+        sim.tick(demand=2.0)
+    assert sim.active() == 1
+    assert sim.scale_events == []
+
+    # Morning spike: traffic triples+ — replicas must grow to the bound,
+    # debounced (never on the first breaching tick).
+    peak_ticks = 0
+    while sim.active() < 3 and peak_ticks < 10:
+        sim.tick(demand=18.0)
+        peak_ticks += 1
+    assert sim.active() == 3, sim.scale_events
+    assert peak_ticks >= 2          # pending -> firing -> act, per step
+    ups = [e for e in sim.scale_events if e["direction"] == "up"]
+    assert [e["to"] for e in ups] == [2, 3]
+    assert all(e["result"] == "ok" for e in ups)
+    # The new replicas actually serve: the gateway's census sees 3 ready.
+    sim.gw.router.poll_once()
+    assert sim.gw.router.ready_count() == 3
+
+    # Hold the peak briefly: stable at max, no flapping.
+    for _ in range(3):
+        sim.tick(demand=18.0)
+    assert sim.active() == 3
+
+    # Evening trough under a live request flood: the fleet shrinks back
+    # to min by DRAINING each victim through the gateway — the flood must
+    # see nothing but 200s (and honest 429s), never an error or a hang.
+    statuses: list[int] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def flood(i: int):
+        while not stop.is_set():
+            try:
+                status, _raw, _ = _post(sim.base, "/v1/generate",
+                                        {"prompt": "x", "deadlineS": 20,
+                                         "prefixId": f"sess-{i}"},
+                                        timeout=30)
+                with lock:
+                    statuses.append(status)
+            except Exception as e:  # noqa: BLE001 — transport error = lost request
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=flood, args=(i,)) for i in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        down_ticks = 0
+        while sim.active() > 1 and down_ticks < 40:
+            sim.tick(demand=0.0)
+            down_ticks += 1
+    finally:
+        time.sleep(0.2)
+        stop.set()
+        for th in threads:
+            th.join(timeout=60)
+    assert not any(th.is_alive() for th in threads), "flood thread hung"
+    assert sim.active() == 1, sim.scale_events
+    downs = [e for e in sim.scale_events if e["direction"] == "down"]
+    assert [e["to"] for e in downs] == [2, 1]
+    assert all(e["result"] == "ok" for e in downs)
+    # Hysteresis: the storm's pressure keeps the down rule quiet for the
+    # 2-minute window + 1-minute hold before the first shrink.
+    assert down_ticks >= 17
+    # Every drained victim finished its in-flight work before removal.
+    assert sim.sims[2].drained and sim.sims[1].drained
+    # ZERO lost requests: only 200/429 ever reached a client.
+    assert not errors, errors
+    assert statuses and set(statuses) <= {200, 429}, sorted(set(statuses))
+    assert statuses.count(200) > 0
+
+    # The error budget survived: no alert fired at any point in the ramp,
+    # and `kuke alerts --check` gates green.
+    fired = [tr for tr in sim.transitions if tr["state"] == "firing"]
+    assert fired == [], fired
+
+    class _Client:
+        def call(self, method, **params):
+            return getattr(sim.svc, method)(**params)
+
+    monkeypatch.setattr(cli, "_client", lambda args: _Client())
+    assert cli.cmd_alerts(argparse.Namespace(json=False, transitions=50,
+                                             check=True)) == 0
+    out = capsys.readouterr().out
+    assert "fleet healthy" in out
+
+    # `kuke scale` renders the loop's state + event history.
+    assert cli.cmd_scale(argparse.Namespace(json=False, name=None)) == 0
+    out = capsys.readouterr().out
+    assert KEY in out
+    assert "recent scale events" in out
+    assert "+1 -> 2" in out and "-1 -> 1" in out
+
+    # The scaler's own telemetry fed the TSDB like any other signal.
+    series = sim.telem.tsdb.query("kukeon_scaler_queue_ratio", 3600,
+                                  "max", at=sim.clock.now)
+    assert {labels["cell"] for labels, _v in series} == {KEY}
+
+    # ScrapeCells decorates the gateway row with the scale state.
+    rows = {r["cell"]: r for r in sim.svc.ScrapeCells()["cells"]}
+    assert rows[KEY]["scale"] == {"desired": 1, "min": 1, "max": 3}
+
+
+def test_scale_down_drain_target_killed_mid_flood(sim, monkeypatch):
+    """Satellite: the drain victim DIES instead of draining. Unreachable
+    means drained (a dead replica holds no requests to lose), so the
+    scaler completes the removal; meanwhile the flood sees only 200/429 —
+    the survivors and the spillover queue absorb the blip."""
+    # Grow to 2 first.
+    while sim.active() < 2:
+        sim.tick(demand=18.0)
+    assert sim.active() == 2
+
+    statuses: list[int] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def flood(i: int):
+        while not stop.is_set():
+            try:
+                status, _raw, _ = _post(sim.base, "/v1/generate",
+                                        {"prompt": "x", "deadlineS": 20},
+                                        timeout=30)
+                with lock:
+                    statuses.append(status)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=flood, args=(i,)) for i in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        time.sleep(0.2)
+        # The victim (highest index = replica 1) crashes outright.
+        sim.sims[1].kill()
+        down_ticks = 0
+        while sim.active() > 1 and down_ticks < 40:
+            sim.tick(demand=0.0)
+            down_ticks += 1
+    finally:
+        time.sleep(0.3)
+        stop.set()
+        for th in threads:
+            th.join(timeout=60)
+    assert not any(th.is_alive() for th in threads), "flood thread hung"
+    assert sim.active() == 1
+    downs = [e for e in sim.scale_events if e["direction"] == "down"]
+    assert downs and downs[-1]["result"] == "ok"
+    assert not errors, errors
+    assert statuses and set(statuses) <= {200, 429}, sorted(set(statuses))

@@ -325,8 +325,11 @@ class _Replica:
 def _gateway(urls):
     from kukeon_tpu.gateway.cell import GatewayCell, make_gateway_handler
 
+    # spill_capacity=0: these tests pin the shed SPAN story; an all-shed
+    # request must terminate immediately instead of parking in the
+    # spillover queue (whose spans are covered in tests/test_scaler.py).
     gw = GatewayCell("tiny", urls, poll_interval_s=0.05,
-                     request_timeout_s=30.0)
+                     request_timeout_s=30.0, spill_capacity=0)
     gw.start()
     srv = ThreadingHTTPServer(("127.0.0.1", 0), make_gateway_handler(gw))
     threading.Thread(target=srv.serve_forever, daemon=True).start()

@@ -153,6 +153,24 @@ INVALID = [
         # 8082 sits inside the replica range 8080..8083.
         "  containers: [{name: m, command: [sh], ports: [{port: 8082}]}]"),
      "collides"),
+    # --- autoscaling bounds ----------------------------------------------
+    ("model-min-without-max", cell(
+        "  model: {model: tiny, minReplicas: 2}"), "maxReplicas"),
+    ("model-max-below-two", cell(
+        "  model: {model: tiny, maxReplicas: 1}"), ">= 2"),
+    ("model-max-below-min", cell(
+        "  model: {model: tiny, minReplicas: 3, maxReplicas: 2}"),
+     "minReplicas"),
+    ("model-replicas-outside-bounds", cell(
+        "  model: {model: tiny, replicas: 5, minReplicas: 1, "
+        "maxReplicas: 4}"), "bounds"),
+    ("model-autoscale-role-split", cell(
+        "  model: {model: tiny, replicas: 2, maxReplicas: 3, "
+        "role: 'prefill,decode'}"), "autoscaling"),
+    # An autoscaled cell claims its FULL maxReplicas port range up front.
+    ("model-autoscale-range-overflow", cell(
+        "  model: {model: tiny, port: 65530, replicas: 2, maxReplicas: 8}"),
+     "65535"),
     # Cross-document: two ModelSpecs in ONE manifest whose replica port
     # ranges overlap (9000..9004 vs 9003..9005) — the error names both.
     ("manifest-replica-port-ranges-collide",
@@ -242,6 +260,9 @@ VALID = [
         "          maxSeqLen: 4096, dtype: int8, hostNetwork: true}")),
     ("replicated-model-cell", cell(
         "  model: {model: llama3-8b, chips: 2, port: 9000, replicas: 4}")),
+    ("autoscaled-model-cell", cell(
+        "  model: {model: llama3-8b, chips: 1, port: 9000, replicas: 2,\n"
+        "          minReplicas: 1, maxReplicas: 6, maxPending: 32}")),
     # Disjoint replica ranges in one manifest: 9000..9004 then 9005..9007.
     ("replicated-models-disjoint",
      cell("  model: {model: tiny, port: 9000, replicas: 4}", name="llm-a")

@@ -3,7 +3,7 @@
 previous round and flag regressions.
 
 The bench artifacts (`bench.py --out BENCH_rNN.json`, schema
-kukeon-bench/v1..v4) are the repo's performance trajectory; this tool is
+kukeon-bench/v1..v5) are the repo's performance trajectory; this tool is
 the cheap guard that a round did not silently give back throughput,
 latency, cold start, or HBM headroom:
 
@@ -33,7 +33,7 @@ import re
 import sys
 
 SCHEMAS = ("kukeon-bench/v1", "kukeon-bench/v2", "kukeon-bench/v3",
-           "kukeon-bench/v4")
+           "kukeon-bench/v4", "kukeon-bench/v5")
 
 # (label, path into the artifact, direction: +1 = higher is better)
 METRICS = (
@@ -48,12 +48,17 @@ METRICS = (
     ("e2e p95 (s)", ("latency_s", "e2e", "p95"), -1),
     ("cold start p50 (s)", ("cold_start", "p50_s"), -1),
     ("peak HBM (bytes)", ("peak_hbm_bytes",), -1),
+    # v5: the diurnal ramp's headline numbers — the peak stage's client
+    # p95 (the latency the spillover queue trades a shed storm for) and
+    # failed requests over the whole ramp (contract: zero).
+    ("diurnal peak p95 (s)", ("diurnal", "peak_p95_s"), -1),
+    ("diurnal failed", ("diurnal", "failed"), -1),
 )
 
 
 def read_artifact(path: str) -> dict | None:
     """A BENCH_rNN.json if it is a bench artifact (any schema version),
-    upgraded to the v4 shape; None for the early raw-transcript rounds."""
+    upgraded to the v5 shape; None for the early raw-transcript rounds."""
     try:
         with open(path) as f:
             artifact = json.load(f)
@@ -61,7 +66,7 @@ def read_artifact(path: str) -> dict | None:
         return None
     if not isinstance(artifact, dict) or artifact.get("schema") not in SCHEMAS:
         return None
-    if artifact["schema"] != "kukeon-bench/v4":
+    if artifact["schema"] != "kukeon-bench/v5":
         artifact = dict(artifact)
         artifact.setdefault("replicas", 1)
         artifact.setdefault("kv_page_tokens", 0)
@@ -70,7 +75,8 @@ def read_artifact(path: str) -> dict | None:
         artifact.setdefault("ttft_p95_s", lat.get("p95"))
         artifact.setdefault("handoff_ms_p50", None)
         artifact.setdefault("disagg", None)
-        artifact["schema"] = "kukeon-bench/v4"
+        artifact.setdefault("diurnal", None)
+        artifact["schema"] = "kukeon-bench/v5"
     return artifact
 
 
