@@ -1,0 +1,94 @@
+"""KUKE013 — heavy module-scope imports in control-plane runtime modules.
+
+`kuke get` answering in 40ms and the daemon booting instantly both depend
+on one invariant: the control plane (CLI, daemon, runner, scaler, store —
+everything under ``kukeon_tpu/runtime/`` EXCEPT the serving cell process
+itself) never imports jax or the model/serving stack at module scope. A
+single ``import jax`` at the top of a runtime module drags multi-second
+framework initialization into every CLI invocation and every daemon
+restart, and it silently survives review because the module still works —
+just slowly. The streamed-boot work (PR 14) makes this worse to get wrong:
+the cold-start budget is now max(disk, transfer, compile), and a control
+plane that pays jax import tax adds a serial prefix no pipeline can hide.
+
+Detection: an ``import``/``from ... import`` statement at module or class
+scope (anything that executes at import time — function bodies are fine,
+that is exactly the lazy-import idiom the codebase uses) whose target
+module is ``jax``/``jax.*``, ``kukeon_tpu.models``/``.models.*``, or
+``kukeon_tpu.serving``/``.serving.*``, in a file under
+``kukeon_tpu/runtime/`` other than ``serving_cell.py`` (the serving
+process is the data plane; its heavy imports are deliberate and measured
+as the ``boot_imports`` cold-start phase).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Sequence
+
+from kukeon_tpu.analysis.core import Finding, SourceFile, register_pass
+
+# Import prefixes that pull the accelerator/model stack in transitively.
+HEAVY_PREFIXES = ("jax", "kukeon_tpu.models", "kukeon_tpu.serving")
+
+# The data-plane process: execs as `python -m ...serving_cell`, measures
+# its own import cost as the boot_imports phase — exempt by design.
+EXEMPT_SUFFIXES = ("runtime/serving_cell.py",)
+
+CONTROL_PLANE_DIR = "kukeon_tpu/runtime/"
+
+
+def _is_heavy(module: str) -> bool:
+    return any(module == p or module.startswith(p + ".")
+               for p in HEAVY_PREFIXES)
+
+
+def _heavy_targets(node: ast.stmt) -> list[str]:
+    """Heavy module names an import statement binds, if any."""
+    out: list[str] = []
+    if isinstance(node, ast.Import):
+        out.extend(a.name for a in node.names if _is_heavy(a.name))
+    elif isinstance(node, ast.ImportFrom) and node.level == 0 and node.module:
+        if _is_heavy(node.module):
+            out.append(node.module)
+        else:
+            # `from kukeon_tpu import models` binds the heavy package too.
+            out.extend(f"{node.module}.{a.name}" for a in node.names
+                       if _is_heavy(f"{node.module}.{a.name}"))
+    return out
+
+
+@register_pass(("KUKE013",))
+def check_boot_imports(sources: Sequence[SourceFile],
+                       package_root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in sources:
+        if CONTROL_PLANE_DIR not in src.rel:
+            continue
+        if src.rel.endswith(EXEMPT_SUFFIXES):
+            continue
+
+        def visit(node: ast.AST, scope: str) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # function bodies import lazily — the fix, not a bug
+            if isinstance(node, ast.ClassDef):
+                for child in ast.iter_child_nodes(node):
+                    visit(child, node.name)  # class bodies run at import
+                return
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for mod in _heavy_targets(node):
+                    findings.append(Finding(
+                        "KUKE013", src.rel, node.lineno,
+                        f"module-scope import of {mod} in a control-plane "
+                        f"runtime module pays framework init on every CLI "
+                        f"call and daemon boot — move it inside the "
+                        f"function that needs it",
+                        scope=scope, detail=f"import:{mod}"))
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, scope)
+
+        for stmt in src.tree.body:
+            visit(stmt, "<module>")
+    return findings

@@ -150,7 +150,7 @@ def _ensure_passes_loaded() -> None:
     # Import the passes for their registration side effect; deferred so
     # core stays importable without the pass modules (fixture tests).
     from kukeon_tpu.analysis import (  # noqa: F401
-        busywait, hostsync, jitstability, locks, registries,
+        bootimports, busywait, hostsync, jitstability, locks, registries,
     )
 
 

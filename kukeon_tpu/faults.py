@@ -61,6 +61,7 @@ POINTS = (
     "alerts.webhook",
     "checkpoint.save",
     "checkpoint.load",
+    "checkpoint.stream",
     "devices.probe_wedged",
     "profile.capture",
 )

@@ -44,6 +44,21 @@ class RolloutStep:
     restart: Callable[[], None]  # bring the drained replica back up
 
 
+@dataclasses.dataclass
+class StandbyStep:
+    """A parked replica pre-warmed to /readyz BEFORE the first victim
+    drains, so the ready census never dips below N while a restarted
+    replica boots (today's window: one full cold start per step). The
+    standby rides outside the scaler's active target — ``start`` boots the
+    parked container without touching ``target_replicas``; ``stop`` parks
+    it again once every active replica is back."""
+
+    name: str                    # parked replica container name
+    url: str                     # parked replica base URL
+    start: Callable[[], None]    # boot the parked container (idempotent)
+    stop: Callable[[], None]     # park it again (idempotent)
+
+
 def _post(url: str, timeout_s: float) -> None:
     req = urllib.request.Request(url, data=b"{}", method="POST",
                                  headers={"Content-Type": "application/json"})
@@ -99,15 +114,69 @@ def rolling_restart(steps: list[RolloutStep], *,
                     ready_timeout_s: float = 300.0,
                     poll_s: float = 0.1,
                     http_timeout_s: float = 2.0,
-                    on_event: Callable[[str, str], None] | None = None
+                    on_event: Callable[[str, str], None] | None = None,
+                    standby: StandbyStep | None = None
                     ) -> list[dict]:
     """Run the drain → wait → restart → wait-ready cycle over every step in
     order. Returns one record per replica; raises RolloutError the moment a
     replica cannot be brought back ready — with the per-step records so far
     (done replicas plus the failed one, its ``error`` naming the stall)
-    attached as ``.results``, so an aborted rollout is resumable by hand."""
+    attached as ``.results``, so an aborted rollout is resumable by hand.
+
+    With ``standby``, a parked replica is booted to /readyz FIRST — before
+    any victim drains — so the serving census holds at N through every
+    step's restart window; it is parked again on the way out (abort
+    included). Every per-step record carries a ``standby`` section naming
+    the pre-warm replica and whether/when it went ready, so an aborted
+    rollout reports whether the standby ever covered the hole."""
     ev = on_event or (lambda _replica, _what: None)
     results: list[dict] = []
+    standby_rec: dict | None = None
+    if standby is not None:
+        ev(standby.name, "standby")
+        try:
+            standby.start()
+        except Exception as e:  # noqa: BLE001 — the summary must name the step
+            raise RolloutError(
+                f"standby {standby.name} failed to start "
+                f"({type(e).__name__}: {e}); rollout not begun "
+                "(no replica was drained)",
+                [{"replica": standby.name, "standby": True,
+                  "error": f"start failed: {type(e).__name__}: {e}"}]) from e
+        ready_s = wait_ready(standby.url, ready_timeout_s, poll_s=poll_s,
+                             http_timeout_s=http_timeout_s)
+        if ready_s is None:
+            try:
+                standby.stop()
+            except Exception:  # noqa: BLE001 — parking best-effort on abort
+                pass
+            raise RolloutError(
+                f"standby {standby.name} did not become ready within "
+                f"{ready_timeout_s:.0f}s; rollout not begun "
+                "(no replica was drained)",
+                [{"replica": standby.name, "standby": True,
+                  "error": f"not ready within {ready_timeout_s:.0f}s"}])
+        ev(standby.name, "ready")
+        standby_rec = {"replica": standby.name, "readyS": round(ready_s, 3)}
+    try:
+        return _rolling_restart_steps(
+            steps, results, ev, standby_rec,
+            drain_timeout_s=drain_timeout_s, ready_timeout_s=ready_timeout_s,
+            poll_s=poll_s, http_timeout_s=http_timeout_s)
+    finally:
+        if standby is not None:
+            try:
+                standby.stop()
+            except Exception:  # noqa: BLE001 — parking best-effort
+                pass
+
+
+def _rolling_restart_steps(steps: list[RolloutStep], results: list[dict],
+                           ev: Callable[[str, str], None],
+                           standby_rec: dict | None, *,
+                           drain_timeout_s: float, ready_timeout_s: float,
+                           poll_s: float, http_timeout_s: float
+                           ) -> list[dict]:
     for step in steps:
         ev(step.name, "drain")
         try:
@@ -122,9 +191,9 @@ def rolling_restart(steps: list[RolloutStep], *,
         try:
             step.restart()
         except Exception as e:  # noqa: BLE001 — the summary must name the step
-            results.append({"replica": step.name, "drained": drained,
-                            "error": f"restart failed: "
-                                     f"{type(e).__name__}: {e}"})
+            results.append(_step_record(
+                standby_rec, replica=step.name, drained=drained,
+                error=f"restart failed: {type(e).__name__}: {e}"))
             raise RolloutError(
                 f"replica {step.name} restart failed "
                 f"({type(e).__name__}: {e}); rollout stopped "
@@ -133,19 +202,30 @@ def rolling_restart(steps: list[RolloutStep], *,
         ready_s = wait_ready(step.url, ready_timeout_s, poll_s=poll_s,
                              http_timeout_s=http_timeout_s)
         if ready_s is None:
-            results.append({
-                "replica": step.name, "drained": drained,
-                "error": f"not ready within {ready_timeout_s:.0f}s "
-                         "after restart"})
+            results.append(_step_record(
+                standby_rec, replica=step.name, drained=drained,
+                error=f"not ready within {ready_timeout_s:.0f}s "
+                      "after restart"))
             raise RolloutError(
                 f"replica {step.name} did not become ready within "
                 f"{ready_timeout_s:.0f}s after restart; rollout stopped "
                 f"({len(results) - 1} of {len(steps)} replicas done)",
                 results)
         ev(step.name, "ready")
-        results.append({"replica": step.name, "drained": drained,
-                        "readyS": round(ready_s, 3)})
+        results.append(_step_record(
+            standby_rec, replica=step.name, drained=drained,
+            readyS=round(ready_s, 3)))
     return results
+
+
+def _step_record(standby_rec: dict | None, **fields) -> dict:
+    """One per-replica outcome record, carrying the standby pre-warm
+    section when the rollout ran with one — an aborted rollout's summary
+    then names whether the standby ever went ready."""
+    rec = dict(fields)
+    if standby_rec is not None:
+        rec["standby"] = dict(standby_rec)
+    return rec
 
 
 def drain_replica(url: str, *, drain_timeout_s: float = 30.0,

@@ -26,10 +26,15 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any
+import queue
+import struct
+import threading
+import time
+from typing import Any, Callable, Iterator
 
 import numpy as np
 
+from kukeon_tpu import faults
 from kukeon_tpu.models.llama import LlamaConfig
 
 QUANT_MANIFEST = "kukeon_quant.json"
@@ -271,3 +276,241 @@ def load_quantized(path: str, dtype=None) -> tuple[dict, LlamaConfig]:
     # at first forward.
     del jnp
     return params, cfg
+
+
+# --- streamed (tensor-granular) checkpoint pipeline ---------------------------
+
+class CheckpointStreamError(RuntimeError):
+    """A reader thread died mid-stream (I/O error, decode error, or the
+    armed ``checkpoint.stream`` fault point). The consumer re-raises this
+    so a boot can fail CLEAN — a half-loaded engine must never flip
+    /readyz."""
+
+
+class TensorSpec:
+    """Shape+dtype stand-in for one param leaf, parsed from the checkpoint
+    manifest before any tensor byte is read. Duck-types the subset of the
+    array interface the sharding planner (``parallel.sharding``) and
+    ``jax.ShapeDtypeStruct`` construction need — deliberately NOT a jax
+    type, so building the abstract tree costs no device work."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape: tuple[int, ...], dtype) -> None:
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = np.dtype(dtype)
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def nbytes(self) -> int:
+        n = self.dtype.itemsize
+        for d in self.shape:
+            n *= d
+        return n
+
+    def __repr__(self) -> str:
+        return f"TensorSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+# safetensors header dtype strings -> numpy dtypes. BF16 resolves lazily
+# (ml_dtypes registers it with numpy via the jax import chain).
+_ST_DTYPES = {
+    "F64": "float64", "F32": "float32", "F16": "float16", "BF16": "bfloat16",
+    "I64": "int64", "I32": "int32", "I16": "int16", "I8": "int8",
+    "U8": "uint8", "BOOL": "bool",
+}
+
+
+def read_safetensors_header(path: str) -> dict[str, TensorSpec]:
+    """tensor name -> TensorSpec from a safetensors file's JSON header —
+    the whole-checkpoint manifest for the cost of one small read (the
+    8-byte length prefix plus the header itself; zero tensor bytes)."""
+    with open(path, "rb") as f:
+        (n,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(n))
+    out: dict[str, TensorSpec] = {}
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        out[name] = TensorSpec(tuple(meta["shape"]),
+                               np.dtype(_ST_DTYPES[meta["dtype"]]))
+    return out
+
+
+def _walk_tree(node, prefix: tuple[str, ...] = ()) -> Iterator[tuple[tuple[str, ...], Any]]:
+    """(path tuple, leaf) pairs of a nested-dict param tree (quantized
+    {"q","s"} dicts are interior nodes here: their arrays are the leaves)."""
+    if isinstance(node, dict):
+        for k in node:
+            yield from _walk_tree(node[k], prefix + (k,))
+    else:
+        yield prefix, node
+
+
+class CheckpointStream:
+    """Bounded-buffer tensor-granular checkpoint reader.
+
+    ``jobs`` is a list of zero-arg callables, each returning
+    ``(leaves, disk_s, cast_s)`` where ``leaves`` is a list of
+    ``(path tuple, np.ndarray)`` pairs ready for device_put. ``threads``
+    reader threads drain the job list concurrently (tensor i+1's disk read
+    overlaps tensor i's upload on the consumer side) and push results
+    through a bounded queue, so host memory holds at most
+    ``buffer + threads`` tensors no matter how far the disk runs ahead of
+    the device link.
+
+    The consumer iterates ``(path, array)`` pairs until every leaf of
+    :attr:`abstract_params` arrived; a reader error (or the armed
+    ``checkpoint.stream`` fault point) surfaces as
+    :class:`CheckpointStreamError` on the consuming thread — fail-clean is
+    the contract, never a silent half-tree.
+
+    :attr:`stats` accumulates ``disk_s`` / ``cast_s`` / ``bytes`` /
+    ``tensors`` under a lock; scrape it via :meth:`stat_snapshot`.
+    """
+
+    def __init__(self, abstract_params: dict, cfg, jobs: list[Callable],
+                 *, threads: int = 4, buffer: int = 16):
+        from kukeon_tpu import sanitize
+
+        self.abstract_params = abstract_params
+        self.cfg = cfg
+        self.total_leaves = sum(1 for _ in _walk_tree(abstract_params))
+        self._jobs = list(jobs)
+        self._jobs_lock = sanitize.lock("CheckpointStream._jobs_lock")
+        self._stats_lock = sanitize.lock("CheckpointStream._stats_lock")
+        self.stats = {"disk_s": 0.0, "cast_s": 0.0,
+                      "bytes": 0, "tensors": 0}        # guarded-by: _stats_lock
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, buffer))
+        self._closed = sanitize.event("CheckpointStream._closed")
+        self._threads = [
+            threading.Thread(target=self._reader, daemon=True,
+                             name=f"ckpt-stream-{i}")
+            for i in range(max(1, min(threads, len(self._jobs) or 1)))
+        ]
+        for t in self._threads:
+            t.start()
+
+    # --- reader side --------------------------------------------------------
+
+    def _reader(self) -> None:
+        while not self._closed.is_set():
+            with self._jobs_lock:
+                if not self._jobs:
+                    return
+                job = self._jobs.pop(0)
+            try:
+                faults.maybe_fail("checkpoint.stream")
+                leaves, disk_s, cast_s = job()
+            except BaseException as e:  # noqa: BLE001 — surfaced to the consumer
+                self._put(("err", CheckpointStreamError(
+                    f"checkpoint stream reader failed: "
+                    f"{type(e).__name__}: {e}"), e))
+                return
+            nbytes = sum(arr.nbytes for _, arr in leaves)
+            with self._stats_lock:
+                self.stats["disk_s"] += disk_s
+                self.stats["cast_s"] += cast_s
+                self.stats["bytes"] += nbytes
+                self.stats["tensors"] += len(leaves)
+            for path, arr in leaves:
+                if not self._put(("leaf", path, arr)):
+                    return
+
+    def _put(self, item) -> bool:
+        """Bounded put that gives up once the stream is closed (a consumer
+        that errored out must not leave readers blocked forever)."""
+        while not self._closed.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # --- consumer side ------------------------------------------------------
+
+    def __iter__(self) -> Iterator[tuple[tuple[str, ...], np.ndarray]]:
+        remaining = self.total_leaves
+        try:
+            while remaining:
+                item = self._q.get()
+                if item[0] == "err":
+                    raise item[1] from item[2]
+                yield item[1], item[2]
+                remaining -= 1
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Stop the readers (idempotent). Iteration closes on completion
+        and on error; an engine tearing down early must call this too."""
+        self._closed.set()
+
+    def stat_snapshot(self) -> dict:
+        with self._stats_lock:
+            return dict(self.stats)
+
+
+def _timed_get(get: Callable[[], np.ndarray]) -> tuple[np.ndarray, float]:
+    t0 = time.monotonic()
+    out = get()
+    return out, time.monotonic() - t0
+
+
+def stream_quantized(path: str, dtype=None, *, threads: int = 4,
+                     buffer: int = 16) -> CheckpointStream:
+    """Streaming twin of :func:`load_quantized`: the abstract param tree
+    and config come from the manifest + safetensors header alone (so
+    ``precompile()`` can start before any tensor byte is read), then
+    reader threads walk the file tensor-by-tensor, casting norms to the
+    activation dtype on the host. Leaf values and tree structure are
+    byte-identical to the materialized loader's."""
+    with open(os.path.join(path, QUANT_MANIFEST)) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != "kukeon-int8-v1":
+        raise ValueError(f"unknown quantized checkpoint format in {path}")
+    cfg = _cfg_from_json(manifest["config"])
+    if dtype is not None:
+        cfg = dataclasses.replace(cfg, dtype=dtype)
+    ndtype = np.dtype(cfg.dtype)
+    st_path = os.path.join(path, "model.quant.safetensors")
+    header = read_safetensors_header(st_path)
+
+    abstract_flat = {
+        name: (TensorSpec(spec.shape, ndtype)
+               if spec.dtype == np.dtype(np.float32)
+               and not name.endswith(".s") else spec)
+        for name, spec in header.items()
+    }
+    abstract = _unflatten_quant(abstract_flat)  # type: ignore[arg-type]
+
+    from safetensors import safe_open
+
+    tls = threading.local()
+
+    def _handle():
+        f = getattr(tls, "f", None)
+        if f is None:
+            f = tls.f = safe_open(st_path, framework="numpy")
+        return f
+
+    def make_job(name: str):
+        want = abstract_flat[name].dtype
+
+        def job():
+            t, disk_s = _timed_get(lambda: _handle().get_tensor(name))
+            t0 = time.monotonic()
+            if t.dtype != want:
+                t = t.astype(want)
+            cast_s = time.monotonic() - t0
+            return [(tuple(name.split(".")), t)], disk_s, cast_s
+
+        return job
+
+    jobs = [make_job(name) for name in header]
+    return CheckpointStream(abstract, cfg, jobs,
+                            threads=threads, buffer=buffer)

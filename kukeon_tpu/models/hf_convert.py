@@ -336,3 +336,259 @@ def load_params_quantized(checkpoint_dir: str,
         if unmapped:
             raise ValueError(f"unmapped tensors in checkpoint: {unmapped[:5]}")
     return params, cfg
+
+
+# --- streamed (leaf-granular) HF loads ----------------------------------------
+
+def _llama_hf_names(cfg: LlamaConfig) -> set[str]:
+    """Every HF tensor name the Llama mapping consumes (the unmapped-tensor
+    guard for the streaming loaders, checked from headers alone)."""
+    names = {"model.embed_tokens.weight", "model.norm.weight"}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        names |= {
+            p + "input_layernorm.weight",
+            p + "self_attn.q_proj.weight", p + "self_attn.k_proj.weight",
+            p + "self_attn.v_proj.weight", p + "self_attn.o_proj.weight",
+            p + "post_attention_layernorm.weight",
+            p + "mlp.gate_proj.weight", p + "mlp.up_proj.weight",
+            p + "mlp.down_proj.weight",
+        }
+    if not cfg.tie_embeddings:
+        names.add("lm_head.weight")
+    return names
+
+
+def _check_mapped(where: dict[str, str], cfg: LlamaConfig) -> None:
+    expected = _llama_hf_names(cfg)
+    unmapped = sorted(set(where) - expected - {"lm_head.weight"})
+    if unmapped:
+        raise ValueError(f"unmapped tensors in checkpoint: {unmapped[:5]}")
+    missing = sorted(expected - set(where))
+    if missing:
+        raise ValueError(f"missing tensors in checkpoint: {missing[:5]}")
+
+
+def _shard_getter(where: dict[str, str]):
+    """name -> tensor via per-thread shard handles (safetensors handles are
+    not shared across the stream's reader threads)."""
+    import threading
+
+    from safetensors import safe_open
+
+    tls = threading.local()
+
+    def get(name: str) -> np.ndarray:
+        handles = getattr(tls, "handles", None)
+        if handles is None:
+            handles = tls.handles = {}
+        shard = where[name]
+        f = handles.get(shard)
+        if f is None:
+            f = handles[shard] = safe_open(shard, framework="numpy")
+        return f.get_tensor(name)
+
+    return get
+
+
+def stream_params(checkpoint_dir: str, cfg: LlamaConfig | None = None,
+                  dtype=jnp.bfloat16, *, threads: int = 2,
+                  buffer: int = 4):
+    """Streaming twin of :func:`load_params`: a CheckpointStream whose
+    abstract tree comes from cfg shapes alone, with one reader job per
+    final pytree leaf (a stacked leaf's job reads its L per-layer tensors,
+    transposes, stacks, and casts — leaf values identical to the
+    materialized loader's)."""
+    import dataclasses
+    import time
+
+    from kukeon_tpu.models import checkpoints as ck
+
+    cfg = cfg or config_from_hf(checkpoint_dir)
+    cfg = dataclasses.replace(cfg, dtype=dtype)
+    where = _open_shards(checkpoint_dir)
+    _check_mapped(where, cfg)
+    get = _shard_getter(where)
+    c = cfg
+    L, H, V, I = c.num_layers, c.hidden_size, c.vocab_size, c.intermediate_size
+    ndtype = np.dtype(cfg.dtype)
+
+    def spec(*shape):
+        return ck.TensorSpec(shape, ndtype)
+
+    abstract = {
+        "embed": spec(V, H),
+        "layers": {
+            "attn_norm": spec(L, H),
+            "wq": spec(L, H, c.q_dim), "wk": spec(L, H, c.kv_dim),
+            "wv": spec(L, H, c.kv_dim), "wo": spec(L, c.q_dim, H),
+            "mlp_norm": spec(L, H),
+            "w_gate": spec(L, H, I), "w_up": spec(L, H, I),
+            "w_down": spec(L, I, H),
+        },
+        "final_norm": spec(H),
+    }
+    if not cfg.tie_embeddings:
+        abstract["lm_head"] = spec(H, V)
+
+    def single_job(path, name, transpose=False):
+        def job():
+            t, disk_s = ck._timed_get(lambda: get(name))
+            t0 = time.monotonic()
+            out = np.asarray(t.T if transpose else t).astype(ndtype)
+            return [(path, out)], disk_s, time.monotonic() - t0
+        return job
+
+    def stack_job(leaf, fmt, transpose):
+        def job():
+            disk_s, tensors = 0.0, []
+            for i in range(L):
+                t, dt = ck._timed_get(lambda i=i: get(fmt.format(i)))
+                disk_s += dt
+                tensors.append(t.T if transpose else t)
+            t0 = time.monotonic()
+            out = np.stack(tensors).astype(ndtype)
+            return ([(("layers", leaf), out)], disk_s,
+                    time.monotonic() - t0)
+        return job
+
+    p = "model.layers.{}."
+    jobs = [
+        single_job(("embed",), "model.embed_tokens.weight"),
+        stack_job("attn_norm", p + "input_layernorm.weight", False),
+        stack_job("wq", p + "self_attn.q_proj.weight", True),
+        stack_job("wk", p + "self_attn.k_proj.weight", True),
+        stack_job("wv", p + "self_attn.v_proj.weight", True),
+        stack_job("wo", p + "self_attn.o_proj.weight", True),
+        stack_job("mlp_norm", p + "post_attention_layernorm.weight", False),
+        stack_job("w_gate", p + "mlp.gate_proj.weight", True),
+        stack_job("w_up", p + "mlp.up_proj.weight", True),
+        stack_job("w_down", p + "mlp.down_proj.weight", True),
+        single_job(("final_norm",), "model.norm.weight"),
+    ]
+    if not cfg.tie_embeddings:
+        jobs.append(single_job(("lm_head",), "lm_head.weight",
+                               transpose=True))
+    return ck.CheckpointStream(abstract, cfg, jobs,
+                               threads=threads, buffer=buffer)
+
+
+def stream_params_quantized(checkpoint_dir: str,
+                            cfg: LlamaConfig | None = None,
+                            dtype=None, *, threads: int = 2,
+                            buffer: int = 4):
+    """Streaming twin of :func:`load_params_quantized`: quantize-on-load,
+    one reader job per final {"q","s"} (or norm) leaf. Peak transient host
+    memory stays at ~one f32 leaf per reader thread."""
+    import dataclasses
+    import time
+
+    from kukeon_tpu.models import checkpoints as ck
+    from kukeon_tpu.models.llama import quantize_np
+
+    if cfg is None:
+        cfg = dataclasses.replace(config_from_hf(checkpoint_dir),
+                                  dtype=dtype or jnp.bfloat16)
+    elif dtype is not None:
+        cfg = dataclasses.replace(cfg, dtype=dtype)
+    where = _open_shards(checkpoint_dir)
+    _check_mapped(where, cfg)
+    get = _shard_getter(where)
+    c = cfg
+    L, H, V, I = c.num_layers, c.hidden_size, c.vocab_size, c.intermediate_size
+    ndtype = np.dtype(cfg.dtype)
+
+    def qspec(*shape):
+        """{"q","s"} abstract pair: int8 matrix + f32 per-output-channel
+        scale (the contracted axis squeezed out — sharding._quant_scale_spec
+        reads these shapes)."""
+        return {"q": ck.TensorSpec(shape, np.int8),
+                "s": ck.TensorSpec(shape[:-2] + shape[-1:], np.float32)}
+
+    abstract = {
+        # embed quantizes along axis=1: s spans the vocab rows.
+        "embed": {"q": ck.TensorSpec((V, H), np.int8),
+                  "s": ck.TensorSpec((V,), np.float32)},
+        "layers": {
+            "attn_norm": ck.TensorSpec((L, H), ndtype),
+            "wq": qspec(L, H, c.q_dim), "wk": qspec(L, H, c.kv_dim),
+            "wv": qspec(L, H, c.kv_dim), "wo": qspec(L, c.q_dim, H),
+            "mlp_norm": ck.TensorSpec((L, H), ndtype),
+            "w_gate": qspec(L, H, I), "w_up": qspec(L, H, I),
+            "w_down": qspec(L, I, H),
+        },
+        "final_norm": ck.TensorSpec((H,), ndtype),
+    }
+    if not cfg.tie_embeddings:
+        abstract["lm_head"] = qspec(H, V)
+
+    def quant_single_job(path, name, axis, transpose):
+        def job():
+            t, disk_s = ck._timed_get(lambda: get(name))
+            t0 = time.monotonic()
+            leaf = quantize_np(t.T if transpose else t, axis=axis)
+            return ([(path + ("q",), leaf["q"]),
+                     (path + ("s",), leaf["s"])],
+                    disk_s, time.monotonic() - t0)
+        return job
+
+    def quant_stack_job(leaf_name, fmt):
+        def job():
+            disk_s = cast_s = 0.0
+            qs, ss = [], []
+            for i in range(L):
+                t, dt = ck._timed_get(lambda i=i: get(fmt.format(i)))
+                disk_s += dt
+                t0 = time.monotonic()
+                leaf = quantize_np(t.T, axis=0)
+                cast_s += time.monotonic() - t0
+                qs.append(leaf["q"])
+                ss.append(leaf["s"])
+            t0 = time.monotonic()
+            q, s = np.stack(qs), np.stack(ss)
+            cast_s += time.monotonic() - t0
+            return ([(("layers", leaf_name, "q"), q),
+                     (("layers", leaf_name, "s"), s)], disk_s, cast_s)
+        return job
+
+    def plain_stack_job(leaf_name, fmt):
+        def job():
+            disk_s, tensors = 0.0, []
+            for i in range(L):
+                t, dt = ck._timed_get(lambda i=i: get(fmt.format(i)))
+                disk_s += dt
+                tensors.append(t)
+            t0 = time.monotonic()
+            out = np.stack(tensors).astype(ndtype)
+            return ([(("layers", leaf_name), out)], disk_s,
+                    time.monotonic() - t0)
+        return job
+
+    def plain_single_job(path, name):
+        def job():
+            t, disk_s = ck._timed_get(lambda: get(name))
+            t0 = time.monotonic()
+            out = t.astype(ndtype)
+            return [(path, out)], disk_s, time.monotonic() - t0
+        return job
+
+    p = "model.layers.{}."
+    jobs = [
+        quant_single_job(("embed",), "model.embed_tokens.weight",
+                         axis=1, transpose=False),
+        plain_stack_job("attn_norm", p + "input_layernorm.weight"),
+        quant_stack_job("wq", p + "self_attn.q_proj.weight"),
+        quant_stack_job("wk", p + "self_attn.k_proj.weight"),
+        quant_stack_job("wv", p + "self_attn.v_proj.weight"),
+        quant_stack_job("wo", p + "self_attn.o_proj.weight"),
+        plain_stack_job("mlp_norm", p + "post_attention_layernorm.weight"),
+        quant_stack_job("w_gate", p + "mlp.gate_proj.weight"),
+        quant_stack_job("w_up", p + "mlp.up_proj.weight"),
+        quant_stack_job("w_down", p + "mlp.down_proj.weight"),
+        plain_single_job(("final_norm",), "model.norm.weight"),
+    ]
+    if not cfg.tie_embeddings:
+        jobs.append(quant_single_job(("lm_head",), "lm_head.weight",
+                                     axis=0, transpose=True))
+    return ck.CheckpointStream(abstract, cfg, jobs,
+                               threads=threads, buffer=buffer)

@@ -1030,11 +1030,21 @@ def cmd_rollout(args):
     s = _scope(args)
     out = c.call("RolloutCell", **s, name=args.name,
                  drainTimeoutS=args.drain_timeout,
-                 readyTimeoutS=args.ready_timeout)
+                 readyTimeoutS=args.ready_timeout,
+                 standby=getattr(args, "standby", True))
     if args.json:
         _print(out, True)
         return 1 if out.get("aborted") else 0
+    sb = next((r["standby"] for r in out["replicas"]
+               if isinstance(r.get("standby"), dict)), None)
+    if sb is not None:
+        print(f"  standby {sb['replica']}: ready in {sb['readyS']}s "
+              "(census held at N throughout)")
     for r in out["replicas"]:
+        if r.get("standby") is True:
+            # The standby itself failed before any replica drained.
+            print(f"  standby {r['replica']}: FAILED: {r.get('error')}")
+            continue
         drained = "drained" if r["drained"] else "drain timeout (restarted anyway)"
         if r.get("error"):
             print(f"  {r['replica']}: {drained}, FAILED: {r['error']}")
@@ -1366,6 +1376,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="seconds to wait for each replica's drain")
     sp.add_argument("--ready-timeout", type=float, default=300.0,
                     help="seconds to wait for each restarted replica's readyz")
+    sp.add_argument("--standby", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="pre-warm a parked replica to /readyz before the "
+                         "first drain so the ready census holds at N "
+                         "(skipped when the cell has no parked capacity)")
     _scope_args(sp)
 
     sp = sub_add("image")

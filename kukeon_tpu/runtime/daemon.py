@@ -855,13 +855,21 @@ class RPCService:
 
     def RolloutCell(self, realm: str, space: str, stack: str, name: str,
                     drainTimeoutS: float = 60.0,
-                    readyTimeoutS: float = 300.0) -> dict:
+                    readyTimeoutS: float = 300.0,
+                    standby: bool = True) -> dict:
         """Rolling restart of a replicated model cell with zero failed
         requests: one replica at a time, drain -> wait drained (a drained
         serving cell exits its HTTP server, so unreachable = drained) ->
         restart on the same chip grant -> wait /readyz 200. The gateway
         keeps the cell serving throughout — draining replicas leave its
-        rotation and stragglers retry onto siblings."""
+        rotation and stragglers retry onto siblings.
+
+        With ``standby`` (the default), a parked replica of an autoscaled
+        cell is pre-warmed to /readyz BEFORE the first victim drains and
+        parked again afterwards, so the ready census holds at N through
+        every restart window. Cells with no parked capacity (no
+        maxReplicas, or already at the bound) roll without one — the
+        flag is a request, not a requirement."""
         from kukeon_tpu.gateway import rollout as ro
 
         rec = self.ctl.store.read_cell(realm or consts.DEFAULT_REALM,
@@ -888,11 +896,24 @@ class RPCService:
                 _rollout_restart(self.ctl, rec, cname)
 
             steps.append(ro.RolloutStep(name=cname, url=url, restart=restart))
+        from kukeon_tpu.runtime.apply.validate import model_scale_bound
+
+        standby_step = None
+        if standby and model_scale_bound(m) > active:
+            sname = f"model-server-{active}"   # first parked index
+            standby_step = ro.StandbyStep(
+                name=sname,
+                url=f"http://{host}:{m.port + 1 + active}",
+                start=lambda: self.ctl.runner.start_parked_replica(
+                    rec.realm, rec.space, rec.stack, rec.name),
+                stop=lambda: self.ctl.runner.stop_parked_replica(
+                    rec.realm, rec.space, rec.stack, rec.name, sname),
+            )
         cell_key = "/".join((rec.realm, rec.space, rec.stack, rec.name))
         try:
             results = ro.rolling_restart(
                 steps, drain_timeout_s=drainTimeoutS,
-                ready_timeout_s=readyTimeoutS)
+                ready_timeout_s=readyTimeoutS, standby=standby_step)
         except ro.RolloutError as e:
             # An aborted rollout is a RESULT, not an RPC failure: the
             # per-step outcome summary (which replicas finished, which one

@@ -960,6 +960,92 @@ class Runner:
             self.store.write_cell(rec)
             return rec
 
+    def start_parked_replica(self, realm: str, space: str, stack: str,
+                             name: str) -> tuple[model.CellRecord, str]:
+        """Boot the FIRST parked replica of an autoscaled model cell on its
+        pre-partitioned chip grant WITHOUT touching ``target_replicas`` —
+        the standby pre-warm primitive (rollout standby, scaler warm pool).
+        The replica serves and answers /readyz but stays outside the active
+        range: the gateway census, phase derivation, and the scaler all
+        keep ignoring it, and reconcile never heals or stops it (parked
+        containers are recorded, never managed). Idempotent — a standby
+        already running is adopted, not restarted. Returns the record and
+        the started container's name."""
+        with self.cell_lock(realm, space, stack, name):
+            rec = self.store.read_cell(realm, space, stack, name)
+            m = rec.spec.model
+            if m is None:
+                raise InvalidArgument(f"cell {name!r} is not a model cell")
+            parked = self._parked_names(rec)
+            if not parked:
+                raise FailedPrecondition(
+                    f"cell {name!r} has no parked replica to pre-warm "
+                    "(active target is already at the scale bound)")
+            # Lowest parked index = the next scale-up promotion target, so
+            # the scaler's first scale-up adopts the warm standby in place.
+            cname = f"model-server-{self.model_target(rec)}"
+            containers = self.cell_containers(rec)
+            spec = next(c for c in containers if c.name == cname)
+            self._ensure_cell_network(rec)
+            ctx = self._container_context(rec, spec)
+            grant = self._chip_slices(containers,
+                                      rec.status.tpu_chips).get(spec.name, [])
+            if grant:
+                ctx.env.update(self.devices.visibility_env(grant))
+                ctx.devices = self.devices.device_nodes(grant)
+            if not self.backend.container_state(ctx).running:
+                self.backend.start_container(ctx)
+            live = self.backend.container_state(ctx)
+            st = rec.status.container(spec.name)
+            if st is None:
+                st = model.ContainerStatus(name=spec.name)
+                rec.status.containers.append(st)
+            st.state = live.state
+            st.pid = live.pid
+            st.exit_code = live.exit_code
+            st.started_at = time.time()
+            st.finished_at = None
+            self.store.write_cell(rec)
+            return rec, cname
+
+    def stop_parked_replica(self, realm: str, space: str, stack: str,
+                            name: str, container: str) -> model.CellRecord:
+        """Park a pre-warmed standby again: stop the named container iff it
+        is OUTSIDE the active range (a replica scale-up promoted into the
+        target is live capacity — stopping it would punch the hole the
+        standby existed to prevent, so that's a silent no-op here).
+        ``target_replicas`` is untouched either way."""
+        import signal as _signal
+
+        with self.cell_lock(realm, space, stack, name):
+            rec = self.store.read_cell(realm, space, stack, name)
+            if container not in self._parked_names(rec):
+                return rec
+            containers = self.cell_containers(rec)
+            spec = next((c for c in containers if c.name == container), None)
+            if spec is None:
+                raise NotFound(
+                    f"container {container!r} not found in cell {name!r}")
+            bare = self._container_context_bare(rec, spec)
+            if self.backend.container_state(bare).running:
+                self.backend.signal_container(bare, _signal.SIGTERM)
+                deadline = time.monotonic() + self.opts.stop_grace_s
+                while (time.monotonic() < deadline
+                       and self.backend.container_state(bare).running):
+                    time.sleep(0.05)
+                if self.backend.container_state(bare).running:
+                    self.backend.signal_container(bare, _signal.SIGKILL)
+            live = self.backend.container_state(bare)
+            st = rec.status.container(spec.name)
+            if st is not None:
+                st.state = live.state
+                st.pid = None
+                st.exit_code = live.exit_code
+                if st.finished_at is None:
+                    st.finished_at = time.time()
+            self.store.write_cell(rec)
+            return rec
+
     def _container_context_bare(self, rec: model.CellRecord, spec: t.ContainerSpec) -> ContainerContext:
         """Context sufficient for signal/state/cleanup (no env building)."""
         cdir = self.store.container_dir(rec.realm, rec.space, rec.stack, rec.name, spec.name)

@@ -58,6 +58,11 @@ log = logging.getLogger("kukeon.scaler")
 DRAIN_TIMEOUT_ENV = "KUKEON_SCALER_DRAIN_TIMEOUT_S"
 DEFAULT_DRAIN_TIMEOUT_S = 30.0
 
+# Pre-warm the next parked replica while a scale-up rule is still in its
+# debounce hold, so the eventual promotion adopts a warm replica instead of
+# cold-starting under load. On by default; set to "0" to disable.
+PREWARM_ENV = "KUKEON_SCALER_PREWARM"
+
 # The serving cell's own CLI default for --max-pending, mirrored here so a
 # spec that never set maxPending still yields a meaningful pressure ratio.
 DEFAULT_MAX_PENDING = 64
@@ -101,6 +106,16 @@ def _materialize_replica(ctl, rec, target: int) -> None:
                                 target)
 
 
+def _prewarm_replica(ctl, rec) -> None:
+    """Pre-warm seam: boot the next parked replica WITHOUT raising the
+    active target, so a scale-up decided seconds later promotes a warm,
+    already-/readyz replica instead of paying a cold start under pressure.
+    Idempotent (a standby already running is adopted); module-level for the
+    same fake-backend-simulator reason as :func:`_materialize_replica`."""
+    ctl.runner.start_parked_replica(rec.realm, rec.space, rec.stack,
+                                    rec.name)
+
+
 def _remove_replica(ctl, rec, target: int) -> None:
     """Scale-down seam: the victim replica is already drained; stop its
     container and persist the lower target."""
@@ -126,6 +141,7 @@ class FleetScaler:
             drain_timeout_s if drain_timeout_s is not None
             else float(os.environ.get(DRAIN_TIMEOUT_ENV, "")
                        or DEFAULT_DRAIN_TIMEOUT_S))
+        self.prewarm = os.environ.get(PREWARM_ENV, "1") != "0"
         # The debounce: a PRIVATE alert engine over the scaler rules (no
         # registry — its firing census must not collide with the real
         # alert engine's kukeon_alerts_firing; no webhook — decisions are
@@ -269,6 +285,23 @@ class FleetScaler:
             lit = firing.get(key, set())
             up = bool(lit & set(_UP_RULES))
             down = _DOWN_RULE in lit
+            # Pre-warm while the pressure debounce is still holding: an
+            # up-rule in pending means a scale-up is likely within for_s —
+            # booting the next parked replica NOW means the promotion
+            # adopts a warm /readyz replica instead of cold-starting under
+            # the very load spike that triggered it. Best-effort: a failed
+            # pre-warm degrades to today's cold promotion, never a skipped
+            # tick.
+            pending_up = any(
+                sig["rules"].get(r) in ("pending", "firing")
+                for r in _UP_RULES)
+            if (self.prewarm and pending_up and not down
+                    and sig["active"] < sig["max"]):
+                try:
+                    _prewarm_replica(self.ctl, rec)
+                    sig["prewarmed"] = True
+                except Exception:  # noqa: BLE001 — degrade to cold promotion
+                    log.exception("scaler: pre-warm on %s failed", key)
             try:
                 if up and sig["active"] < sig["max"]:
                     events.append(self._scale_up(key, rec, sig, now))

@@ -530,15 +530,19 @@ class ServingCell(LifecycleMixin):
 
     @staticmethod
     def _load_checkpoint(path: str, cfg, quantize: bool = False):
-        """(params, cfg) from, in precedence order:
+        """(params-or-stream, cfg) from, in precedence order:
 
         - a kukeon int8 quantized checkpoint (kukeon_quant.json manifest) —
-          the cold-start fast path: int8 streams straight to the device with
-          zero quantization work;
+          the cold-start fast path: a tensor-granular CheckpointStream
+          whose config and abstract shapes come from the manifest alone,
+          so this returns before any tensor byte is read and the engine
+          overlaps disk / cast / upload / compile;
         - an HF safetensors directory (config.json + *.safetensors, the hub
-          layout) — streamed and host-quantized when ``quantize`` (an 8B
-          bf16 tree cannot be materialized on a 16 GB chip);
-        - an orbax checkpoint path.
+          layout) — the same streaming pipeline, host-quantizing per leaf
+          when ``quantize`` (an 8B bf16 tree cannot be materialized on a
+          16 GB chip);
+        - an orbax checkpoint path (materialized — orbax has no
+          tensor-granular reader here).
         """
         import os
 
@@ -547,13 +551,17 @@ class ServingCell(LifecycleMixin):
         from kukeon_tpu.models import checkpoints, llama
 
         if checkpoints.is_quantized_checkpoint(path):
-            return checkpoints.load_quantized(path, dtype=cfg.dtype)
+            stream = checkpoints.stream_quantized(path, dtype=cfg.dtype)
+            return stream, stream.cfg
         if os.path.isdir(path) and os.path.exists(os.path.join(path, "config.json")):
             from kukeon_tpu.models import hf_convert
 
             if quantize:
-                return hf_convert.load_params_quantized(path, dtype=cfg.dtype)
-            return hf_convert.load_params(path, dtype=cfg.dtype)
+                stream = hf_convert.stream_params_quantized(
+                    path, dtype=cfg.dtype)
+            else:
+                stream = hf_convert.stream_params(path, dtype=cfg.dtype)
+            return stream, stream.cfg
         import orbax.checkpoint as ocp
 
         abstract = jax.eval_shape(lambda k: llama.init_params(k, cfg), jax.random.key(0))
@@ -570,7 +578,23 @@ class ServingCell(LifecycleMixin):
         # async checkpoint transfer).
         self.engine.precompile((prompt_len,))
         self._boot_marks.setdefault("compile_done", time.monotonic())
-        self.engine.warmup(prompt_len)
+        try:
+            self.engine.warmup(prompt_len)
+        except RuntimeError as e:
+            from kukeon_tpu.models.checkpoints import CheckpointStreamError
+
+            if isinstance(e.__cause__, CheckpointStreamError):
+                # A mid-stream read/decode failure (or the armed
+                # checkpoint.stream fault point) must never leave a
+                # half-loaded engine a step from /readyz. SystemExit is
+                # NOT an Exception, so main()'s cache-bust retry does not
+                # swallow it: the cell exits with a clear message and the
+                # runner's restart policy recovers it on the same grant.
+                raise SystemExit(
+                    f"serving-cell: checkpoint stream failed during boot "
+                    f"({e.__cause__}); exiting for the restart policy to "
+                    f"recover") from e
+            raise
         self._boot_marks.setdefault("warmup_done", time.monotonic())
 
     def finish_boot(self) -> dict[str, float]:
@@ -594,6 +618,19 @@ class ServingCell(LifecycleMixin):
                                      m["compile_done"]) - m["compile_done"]
         total = now - _PROC_T0
         phases["serve"] = max(0.0, total - sum(phases.values()))
+        # Streamed-checkpoint sub-phases (disk / cast / upload): measured
+        # AFTER the serial partition above is closed, because they overlap
+        # it — the reader threads' file reads and host casts and the load
+        # thread's sharded uploads all run inside the init/compile/warmup
+        # wall time. Their presence makes sum(phases) exceed the total;
+        # that excess IS the overlap the streamed boot buys.
+        eng = self.engine
+        cs = (eng._ckpt_stream.stat_snapshot()
+              if getattr(eng, "_ckpt_stream", None) is not None else {})
+        load = {"disk": cs.get("disk_s", 0.0), "cast": cs.get("cast_s", 0.0),
+                "upload": eng.load_stats.get("upload_s", 0.0)}
+        if any(load.values()):
+            phases.update(load)
         reg = self.registry
         reg.gauge(
             "kukeon_cold_start_seconds",

@@ -283,6 +283,16 @@ class ServingEngine:
         # matching PartitionSpec tree (default: the Llama specs).
         self._forward = forward_fn or llama.forward
         self._param_specs = param_specs
+        # Streamed checkpoint boot (models/checkpoints.CheckpointStream,
+        # duck-typed on .abstract_params): the constructor sees only the
+        # manifest-derived abstract tree — shardings and _abstract_params
+        # come from shapes alone, so precompile() can start before any
+        # tensor byte is read — while the async_load thread drains the
+        # stream leaf-by-leaf through the counted _upload seam.
+        self._ckpt_stream = (params if hasattr(params, "abstract_params")
+                             else None)
+        ptree = (self._ckpt_stream.abstract_params
+                 if self._ckpt_stream is not None else params)
         # Forwards that accept ``logit_positions`` let prefill compute the
         # LM head at ONE position instead of all S bucket rows — at 8B
         # shapes that removes a [S, 128k] f32 logits tensor (and its S×H×V
@@ -345,7 +355,7 @@ class ServingEngine:
                 _os.environ.get("KUKEON_INT8_PALLAS", "").lower()
                 in ("1", "true", "yes", "on")
                 and jax.default_backend() == "tpu"
-                and llama._is_q(params.get("layers", {}).get("wq"))
+                and llama._is_q(ptree.get("layers", {}).get("wq"))
             )
             # The mesh guard applies to BOTH triggers: auto mode must clear
             # a pallas-enabled cfg on a multi-chip mesh (per-layer weight
@@ -415,28 +425,40 @@ class ServingEngine:
         # (scraped as kukeon_engine_host_sync_seconds_total).
         self.sync_stats = {"fetches": 0, "uploads": 0, "chunks": 0,
                            "fetch_s": 0.0, "upload_s": 0.0}
+        # Streamed-boot upload accounting, separate from sync_stats so the
+        # serving-path host-sync budget and the one-off checkpoint transfer
+        # never share a ledger (kukeon_checkpoint_load_seconds{stage=upload}
+        # reads this; the cell's boot breakdown sums it with the stream's
+        # own disk/cast numbers).
+        self.load_stats = {"upload_s": 0.0, "bytes": 0, "tensors": 0}
 
         if mesh is None:
             raise ValueError("ServingEngine requires a mesh (use make_mesh(tensor=1) for one device)")
         # Abstract (shape+sharding) view of the params, available before any
         # byte reaches the device — what precompile() lowers against.
-        self._shardings = shd.param_shardings(params, mesh, specs=self._param_specs)
+        self._shardings = shd.param_shardings(ptree, mesh, specs=self._param_specs)
         self._abstract_params = jax.tree.map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
-            params, self._shardings,
+            ptree, self._shardings,
         )
         self._load_exc: Exception | None = None
         self._loaded = sanitize.event("ServingEngine._loaded")
         if async_load:
             # Weight transfer off-thread so cold start can overlap it with
             # precompile(): the boot pays max(transfer, compile), not the
-            # sum. On a tunneled chip both are minutes; this matters.
+            # sum. On a tunneled chip both are minutes; this matters. With
+            # a CheckpointStream the same thread consumes device-ready
+            # leaves AS THEY ARRIVE off disk, collapsing the whole boot to
+            # max(disk, transfer, compile).
             self.params = None
 
             def _load():
                 try:
-                    self.params = shd.shard_params(
-                        params, mesh, specs=self._param_specs)
+                    if self._ckpt_stream is not None:
+                        self.params = self._consume_stream(self._ckpt_stream)
+                    else:
+                        self.params = shd.shard_params(
+                            params, mesh, specs=self._param_specs)
                     with set_mesh(mesh):
                         self.state = self._init_state()
                 except Exception as e:  # noqa: BLE001 — surfaced by _ensure_loaded
@@ -447,8 +469,11 @@ class ServingEngine:
             threading.Thread(target=_load, daemon=True,
                              name="engine-weight-load").start()
         else:
-            self.params = shd.shard_params(params, mesh,
-                                           specs=self._param_specs)
+            if self._ckpt_stream is not None:
+                self.params = self._consume_stream(self._ckpt_stream)
+            else:
+                self.params = shd.shard_params(params, mesh,
+                                               specs=self._param_specs)
             with set_mesh(mesh):
                 self.state = self._init_state()
             self._loaded.set()
@@ -988,15 +1013,46 @@ class ServingEngine:
         self.sync_stats["fetch_s"] += time.monotonic() - t0
         return out
 
-    def _upload(self, x):
-        """Host→device array upload, counted and timed."""
+    def _upload(self, x, sharding=None):
+        """Host→device array upload, counted and timed. ``sharding`` routes
+        the upload through a per-leaf sharded device_put — the streamed
+        checkpoint path's placement primitive; plain serving-path uploads
+        keep the default-device jnp.asarray."""
         faults.maybe_fail("engine.upload")
         sanitize.blocking("engine._upload device transfer")
         t0 = time.monotonic()
-        out = jnp.asarray(x)
+        if sharding is None:
+            out = jnp.asarray(x)
+        else:
+            out = jax.device_put(x, sharding)
         self.sync_stats["uploads"] += 1
         self.sync_stats["upload_s"] += time.monotonic() - t0
         return out
+
+    def _consume_stream(self, stream):
+        """Drain a CheckpointStream into the device param tree: each leaf
+        goes through the counted _upload seam with its own NamedSharding
+        the moment its bytes arrive off disk, so tensor i+1's read (the
+        stream's reader threads) overlaps tensor i's device transfer.
+        Raises the stream's CheckpointStreamError through to _load_exc —
+        a half-streamed boot fails clean, it never serves."""
+        from kukeon_tpu.models.checkpoints import _walk_tree
+
+        flat_sh = dict(_walk_tree(self._shardings))
+        flat: dict[tuple, Any] = {}
+        for path, arr in stream:
+            t0 = time.monotonic()
+            flat[path] = self._upload(arr, sharding=flat_sh[path])
+            self.load_stats["upload_s"] += time.monotonic() - t0
+            self.load_stats["bytes"] += arr.nbytes
+            self.load_stats["tensors"] += 1
+        tree: dict = {}
+        for path, leaf in flat.items():
+            node = tree
+            for k in path[:-1]:
+                node = node.setdefault(k, {})
+            node[path[-1]] = leaf
+        return tree
 
     def _obs_collect(self):
         """Scrape-time counter families sourced from the live dicts the
@@ -1016,6 +1072,23 @@ class ServingEngine:
         yield ("kukeon_engine_decode_chunks_total", "counter",
                "Dispatched multi-step decode chunks.",
                [({}, float(s["chunks"]))])
+        # Streamed-checkpoint boot pipeline accounting: per-stage wall time
+        # (stages OVERLAP — their sum exceeds the load's wall clock by
+        # design) and bytes moved. All-zero on a non-streamed boot.
+        ls = self.load_stats
+        cs = (self._ckpt_stream.stat_snapshot()
+              if self._ckpt_stream is not None else {})
+        yield ("kukeon_checkpoint_load_bytes_total", "counter",
+               "Checkpoint bytes streamed host->device during boot.",
+               [({}, float(max(int(cs.get("bytes", 0)), ls["bytes"])))])
+        yield ("kukeon_checkpoint_load_seconds", "counter",
+               "Streamed checkpoint load wall time by pipeline stage "
+               "(disk = reader-thread file reads, cast = host dtype "
+               "casts/quantize, upload = sharded device_put). Stages run "
+               "concurrently: their sum exceeds the load wall clock.",
+               [({"stage": "disk"}, float(cs.get("disk_s", 0.0))),
+                ({"stage": "cast"}, float(cs.get("cast_s", 0.0))),
+                ({"stage": "upload"}, float(ls["upload_s"]))])
         yield ("kukeon_engine_prefix_cache_total", "counter",
                "Prefix-KV cache lookups by result.",
                [({"result": "hit"}, float(self.prefix_hits)),

@@ -1,0 +1,279 @@
+"""Streamed checkpoint boot pipeline (PR 14 tentpole): tensor-granular
+stream parity against the materialized loaders, the disk/upload overlap
+the bounded-buffer pipeline buys, fail-clean behavior at the
+``checkpoint.stream`` fault point, and the cold-start sub-phase ledger
+(disk / cast / upload) the cell exports on top of its serial phase
+partition."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from kukeon_tpu import faults
+from kukeon_tpu.models import checkpoints, hf_convert, llama
+from kukeon_tpu.models.checkpoints import (
+    CheckpointStream, CheckpointStreamError, TensorSpec, _walk_tree,
+)
+from kukeon_tpu.parallel import make_mesh
+from kukeon_tpu.serving import SamplingParams, ServingEngine
+
+
+def _tiny_cfg():
+    return llama.llama_tiny()
+
+
+def _quant_dir(tmp_path):
+    cfg = _tiny_cfg()
+    qp = llama.quantize_params(llama.init_params(jax.random.key(0), cfg))
+    qdir = tmp_path / "q"
+    checkpoints.save_quantized(str(qdir), jax.tree.map(np.asarray, qp), cfg)
+    return str(qdir), cfg
+
+
+def _assert_tree_equal(flat, ref_tree):
+    flat_ref = dict(_walk_tree(ref_tree))
+    assert set(flat) == set(flat_ref)
+    for k in flat_ref:
+        a, b = np.asarray(flat[k]), np.asarray(flat_ref[k])
+        assert a.dtype == b.dtype, (k, a.dtype, b.dtype)
+        assert a.shape == b.shape, (k, a.shape, b.shape)
+        np.testing.assert_array_equal(a.astype(np.float32),
+                                      b.astype(np.float32), err_msg=str(k))
+
+
+class TestStreamParity:
+    """Every leaf the streamed loaders yield must be byte-identical to the
+    materialized twin — same dtype, same shape, same values — and the
+    abstract tree (what precompile lowers against before any tensor byte
+    is read) must mirror the real tree exactly."""
+
+    def test_stream_quantized_matches_load_quantized(self, tmp_path):
+        qdir, _cfg = _quant_dir(tmp_path)
+        ref, _refcfg = checkpoints.load_quantized(qdir, dtype="bfloat16")
+        stream = checkpoints.stream_quantized(qdir, dtype="bfloat16")
+        flat = dict(stream)
+        _assert_tree_equal(flat, ref)
+        st = stream.stat_snapshot()
+        assert st["tensors"] == len(flat)
+        assert st["bytes"] > 0 and st["disk_s"] > 0.0
+
+        # The abstract tree needs only the manifest + safetensors header.
+        ab = dict(_walk_tree(stream.abstract_params))
+        flat_ref = dict(_walk_tree(ref))
+        assert set(ab) == set(flat_ref)
+        for k, spec in ab.items():
+            assert spec.shape == np.asarray(flat_ref[k]).shape
+            assert np.dtype(spec.dtype) == np.asarray(flat_ref[k]).dtype
+
+    def test_stream_params_matches_load_params(self, tmp_path):
+        cfg = _tiny_cfg()
+        checkpoints.synthesize_hf_checkpoint(str(tmp_path), cfg,
+                                             dtype=np.float32,
+                                             tokenizer=False)
+        ref, _ = hf_convert.load_params(str(tmp_path), dtype="bfloat16")
+        stream = hf_convert.stream_params(str(tmp_path), dtype="bfloat16")
+        _assert_tree_equal(dict(stream), ref)
+
+    def test_stream_params_quantized_matches_loader(self, tmp_path):
+        cfg = _tiny_cfg()
+        checkpoints.synthesize_hf_checkpoint(str(tmp_path), cfg,
+                                             dtype=np.float32,
+                                             tokenizer=False)
+        ref, _ = hf_convert.load_params_quantized(str(tmp_path),
+                                                  dtype="bfloat16")
+        stream = hf_convert.stream_params_quantized(str(tmp_path),
+                                                    dtype="bfloat16")
+        _assert_tree_equal(dict(stream), ref)
+
+
+class TestStreamedEngineBoot:
+    def test_streamed_boot_greedy_parity(self, tmp_path):
+        """An engine booted from a CheckpointStream (async_load, leaves
+        uploaded as they arrive) must generate exactly what an engine
+        booted from the materialized tree generates, and must account the
+        transfer on load_stats — not on the serving-path sync ledger."""
+        qdir, _cfg = _quant_dir(tmp_path)
+        mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+        prompt = np.arange(3, 35, dtype=np.int32)
+        sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+
+        ref, refcfg = checkpoints.load_quantized(qdir, dtype="bfloat16")
+        want = ServingEngine(refcfg, ref, mesh, num_slots=2,
+                             max_seq_len=64).generate(prompt, sp)
+
+        stream = checkpoints.stream_quantized(qdir, dtype="bfloat16")
+        eng = ServingEngine(stream.cfg, stream, mesh, num_slots=2,
+                            max_seq_len=64, async_load=True)
+        base_uploads = eng.sync_stats["uploads"]
+        got = eng.generate(prompt, sp)
+        assert got == want
+        assert eng.load_stats["tensors"] == stream.total_leaves
+        assert eng.load_stats["bytes"] > 0
+        assert eng.load_stats["upload_s"] > 0.0
+        # The checkpoint transfer ledger is separate from the decode-path
+        # host-sync budget: uploads DID go through the counted seam.
+        assert eng.sync_stats["uploads"] > base_uploads
+
+        fams = {f[0]: f for f in eng._obs_collect()}
+        by_stage = {lab["stage"]: v for lab, v in
+                    fams["kukeon_checkpoint_load_seconds"][3]}
+        assert by_stage["disk"] > 0.0
+        assert by_stage["upload"] > 0.0
+        (_lab, nbytes), = fams["kukeon_checkpoint_load_bytes_total"][3]
+        assert nbytes == float(eng.load_stats["bytes"])
+
+    def test_precompile_needs_no_tensor_bytes(self, tmp_path):
+        """precompile() lowers against the abstract tree — it must finish
+        while the stream has not yielded a single leaf (the compile leg
+        of max(disk, transfer, compile) starts before any byte is read)."""
+        import threading
+
+        qdir, _cfg = _quant_dir(tmp_path)
+        stream = checkpoints.stream_quantized(qdir, dtype="bfloat16")
+        ref, refcfg = checkpoints.load_quantized(qdir, dtype="bfloat16")
+        stream.close()
+        gate = threading.Event()
+
+        class Gated:
+            """Duck-typed stream whose leaves arrive only after the gate
+            opens — while it is shut, precompile is on its own."""
+            abstract_params = stream.abstract_params
+            cfg = stream.cfg
+
+            def stat_snapshot(self):
+                return {}
+
+            def __iter__(self):
+                gate.wait()
+                yield from _walk_tree(jax.tree.map(np.asarray, ref))
+
+        mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+        eng = ServingEngine(refcfg, Gated(), mesh, num_slots=2,
+                            max_seq_len=64, async_load=True)
+        eng.precompile((8,))   # must return with zero tensor bytes read
+        gate.set()
+        want = ServingEngine(refcfg, ref, mesh, num_slots=2,
+                             max_seq_len=64).generate(
+            np.arange(3, 11, dtype=np.int32),
+            SamplingParams(temperature=0.0, max_new_tokens=4))
+        got = eng.generate(np.arange(3, 11, dtype=np.int32),
+                           SamplingParams(temperature=0.0, max_new_tokens=4))
+        assert got == want
+
+    def test_streamed_boot_overlaps_disk_and_upload(self):
+        """The acceptance overlap proof, device-free: a throttled reader
+        (every job sleeps D on 'disk') feeding a throttled consumer (U per
+        leaf 'upload') must finish in ~max-leg pipeline time, far under
+        the serial sum a materialize-then-upload boot pays."""
+        N, D, U = 8, 0.05, 0.05
+        abstract = {f"t{i}": TensorSpec((4,), np.float32) for i in range(N)}
+
+        def make_job(i):
+            def job():
+                t0 = time.monotonic()
+                time.sleep(D)   # the fake-slow disk read
+                arr = np.full((4,), float(i), np.float32)
+                return [((f"t{i}",), arr)], time.monotonic() - t0, 0.0
+            return job
+
+        stream = CheckpointStream(abstract, None, [make_job(i)
+                                                   for i in range(N)],
+                                  threads=2, buffer=2)
+        t0 = time.monotonic()
+        seen = []
+        for path, arr in stream:
+            time.sleep(U)       # the fake device upload
+            seen.append(path)
+        wall = time.monotonic() - t0
+        assert len(seen) == N
+        serial = N * (D + U)
+        # Pipelined wall ~ N*U + D (consumer-bound with 2 reader threads);
+        # anything under 75% of the serial sum proves the overlap.
+        assert wall < serial * 0.75, (wall, serial)
+        st = stream.stat_snapshot()
+        assert st["disk_s"] >= N * D * 0.9
+
+
+class TestCheckpointStreamFault:
+    def test_armed_stream_fault_raises_clean(self, tmp_path, monkeypatch):
+        """checkpoint.stream armed at prob 1 must surface as a
+        CheckpointStreamError from the iterator (counted on the fault
+        point), and an engine booting off that stream must fail its load
+        with the stream error as the cause — never half-serve."""
+        qdir, _cfg = _quant_dir(tmp_path)
+        monkeypatch.setenv("KUKEON_FAULTS", "checkpoint.stream:1:1")
+        faults.reset()
+        stream = checkpoints.stream_quantized(qdir, dtype="bfloat16")
+        with pytest.raises(CheckpointStreamError):
+            dict(stream)
+        assert faults.fired("checkpoint.stream") == 1
+
+        monkeypatch.setenv("KUKEON_FAULTS", "checkpoint.stream:1:1")
+        faults.reset()
+        mesh = make_mesh(tensor=1, devices=jax.devices()[:1])
+        stream2 = checkpoints.stream_quantized(qdir, dtype="bfloat16")
+        eng = ServingEngine(stream2.cfg, stream2, mesh, num_slots=2,
+                            max_seq_len=64, async_load=True)
+        with pytest.raises(RuntimeError) as ei:
+            eng.generate(np.arange(3, 11, dtype=np.int32),
+                         SamplingParams(temperature=0.0, max_new_tokens=2))
+        assert isinstance(ei.value.__cause__, CheckpointStreamError)
+
+    def test_serving_cell_exits_clean_on_stream_fault(self, tmp_path,
+                                                      monkeypatch):
+        """The cell-level contract: a mid-stream failure during boot is a
+        SystemExit (which main()'s compile-cache-bust retry — an `except
+        Exception` — does NOT swallow), so /readyz never flips on a
+        half-loaded engine and the runner restart policy recovers."""
+        from kukeon_tpu.runtime.serving_cell import ServingCell
+
+        qdir, _cfg = _quant_dir(tmp_path)
+        monkeypatch.setenv("KUKEON_FAULTS", "checkpoint.stream:1:1")
+        faults.reset()
+        cell = ServingCell("tiny", num_slots=2, max_seq_len=64,
+                           checkpoint=qdir, dtype=None)
+        with pytest.raises(SystemExit, match="checkpoint stream failed"):
+            cell.warmup()
+        assert faults.fired("checkpoint.stream") >= 1
+        assert not isinstance(SystemExit(), Exception)  # retry-proof
+
+    def test_armed_prob_zero_boots_fine(self, tmp_path, monkeypatch):
+        """The other armed branch: the point armed at prob 0 must never
+        fire — the streamed boot completes and serves."""
+        from kukeon_tpu.runtime.serving_cell import ServingCell
+
+        qdir, _cfg = _quant_dir(tmp_path)
+        monkeypatch.setenv("KUKEON_FAULTS", "checkpoint.stream:0")
+        faults.reset()
+        cell = ServingCell("tiny", num_slots=2, max_seq_len=64,
+                           checkpoint=qdir, dtype=None)
+        cell.warmup(prompt_len=16)
+        out = cell.generate({"promptTokens": [3, 1, 4], "maxNewTokens": 4,
+                             "temperature": 0.0})
+        assert out["numTokens"] == 4
+        assert faults.fired("checkpoint.stream") == 0
+
+
+class TestBootSubPhases:
+    def test_finish_boot_exports_load_sub_phases(self, tmp_path):
+        """A streamed boot's phase breakdown carries the disk/cast/upload
+        work-time ledgers ON TOP of the serial partition — sum(phases)
+        exceeds the total, and that excess is the measured overlap."""
+        from kukeon_tpu.runtime.serving_cell import ServingCell
+
+        qdir, _cfg = _quant_dir(tmp_path)
+        cell = ServingCell("tiny", num_slots=2, max_seq_len=64,
+                           checkpoint=qdir, dtype=None)
+        cell.warmup(prompt_len=16)
+        phases = cell.finish_boot()
+        for stage in ("disk", "cast", "upload"):
+            assert stage in phases, phases
+        assert phases["disk"] > 0.0 and phases["upload"] > 0.0
+        total = cell.registry.get("kukeon_cold_start_seconds").value()
+        assert sum(phases.values()) > total
+        g = cell.registry.get("kukeon_cold_start_phase_seconds")
+        assert g is not None

@@ -27,14 +27,22 @@ def test_unarmed_is_cheap_relative_to_armed_miss():
     even an armed-but-different-point lookup (which pays the lock)."""
     import timeit
 
-    unarmed = timeit.timeit(
-        lambda: faults.maybe_fail("p"), number=20000)
-    os.environ[faults.ENV] = "other.point:1"
-    try:
-        armed_miss = timeit.timeit(
-            lambda: faults.maybe_fail("p"), number=20000)
-    finally:
-        del os.environ[faults.ENV]
+    # Both paths share the os.environ lookup that dominates their cost, so
+    # the real gap is only ~10% — one scheduler hiccup can invert a single
+    # sample.  Take the min of several repeats and allow a bounded retry:
+    # the unarmed path is deterministically cheaper, so three consecutive
+    # inversions would mean the guard is broken, not the clock.
+    for _ in range(3):
+        unarmed = min(timeit.repeat(
+            lambda: faults.maybe_fail("p"), number=50000, repeat=3))
+        os.environ[faults.ENV] = "other.point:1"
+        try:
+            armed_miss = min(timeit.repeat(
+                lambda: faults.maybe_fail("p"), number=50000, repeat=3))
+        finally:
+            del os.environ[faults.ENV]
+        if unarmed < armed_miss:
+            break
     assert unarmed < armed_miss
 
 

@@ -962,7 +962,7 @@ def _load_bench():
     return mod
 
 
-def test_bench_artifact_v5_and_backcompat(tmp_path):
+def test_bench_artifact_v6_and_backcompat(tmp_path):
     bench = _load_bench()
     serve = {"backend": "cpu", "n_chips": 1, "model": "tiny",
              "model_id": "tiny", "sessions": 4, "tok_per_s": 100.0,
@@ -975,7 +975,7 @@ def test_bench_artifact_v5_and_backcompat(tmp_path):
                           "disagg": {"arms": {}},
                           "diurnal": {"peak_p95_s": 0.8, "failed": 0}})
     art = bench.read_artifact(str(out))
-    assert art["schema"] == "kukeon-bench/v5"
+    assert art["schema"] == "kukeon-bench/v6"
     assert art["replicas"] == 3
     assert art["kv_page_tokens"] == 16
     assert art["max_sessions"] == 9
@@ -991,7 +991,7 @@ def test_bench_artifact_v5_and_backcompat(tmp_path):
     v1.write_text(json.dumps({"schema": "kukeon-bench/v1", "backend": "cpu",
                               "tok_per_s": 50.0, "sessions": 4}))
     art = bench.read_artifact(str(v1))
-    assert art["schema"] == "kukeon-bench/v5"
+    assert art["schema"] == "kukeon-bench/v6"
     assert art["replicas"] == 1
     assert art["tok_per_s"] == 50.0
     assert art["kv_page_tokens"] == 0
@@ -1009,7 +1009,7 @@ def test_bench_artifact_v5_and_backcompat(tmp_path):
                               "replicas": 2,
                               "latency_s": {"ttft": {"p95": 0.4}}}))
     art = bench.read_artifact(str(v2))
-    assert art["schema"] == "kukeon-bench/v5"
+    assert art["schema"] == "kukeon-bench/v6"
     assert art["replicas"] == 2
     assert art["kv_page_tokens"] == 0
     assert art["max_sessions"] == 2
@@ -1022,7 +1022,7 @@ def test_bench_artifact_v5_and_backcompat(tmp_path):
                               "replicas": 1, "kv_page_tokens": 16,
                               "max_sessions": 4}))
     art = bench.read_artifact(str(v3))
-    assert art["schema"] == "kukeon-bench/v5"
+    assert art["schema"] == "kukeon-bench/v6"
     assert art["kv_page_tokens"] == 16
     assert art["max_sessions"] == 4
     assert art["handoff_ms_p50"] is None
@@ -1037,11 +1037,25 @@ def test_bench_artifact_v5_and_backcompat(tmp_path):
                               "handoff_ms_p50": 10.0,
                               "disagg": {"arms": {}}}))
     art = bench.read_artifact(str(v4))
-    assert art["schema"] == "kukeon-bench/v5"
+    assert art["schema"] == "kukeon-bench/v6"
     assert art["ttft_p95_s"] == 0.3
     assert art["handoff_ms_p50"] == 10.0
     assert art["disagg"] == {"arms": {}}
     assert art["diurnal"] is None
+
+    # A v5 point (pre-streamed-boot) gains only the cold-start load
+    # sub-phase ledger: explicit None — no disk/cast/upload existed.
+    v5 = tmp_path / "BENCH_r09.json"
+    v5.write_text(json.dumps({"schema": "kukeon-bench/v5", "backend": "cpu",
+                              "tok_per_s": 90.0, "sessions": 2,
+                              "replicas": 2, "kv_page_tokens": 16,
+                              "max_sessions": 4, "ttft_p95_s": 0.3,
+                              "diurnal": {"peak_p95_s": 0.8, "failed": 0},
+                              "cold_start": {"p50_s": 30.0}}))
+    art = bench.read_artifact(str(v5))
+    assert art["schema"] == "kukeon-bench/v6"
+    assert art["diurnal"] == {"peak_p95_s": 0.8, "failed": 0}
+    assert art["cold_start"] == {"p50_s": 30.0, "load_s": None}
 
     bad = tmp_path / "BENCH_bad.json"
     bad.write_text(json.dumps({"schema": "nope/v9"}))

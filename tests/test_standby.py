@@ -1,0 +1,410 @@
+"""Pre-warmed standby rollouts (PR 14 fleet half): the runner's parked
+replica start/stop primitives, the rolling restart's standby pre-warm
+(census held at N through every restart window), the RolloutCell RPC +
+CLI plumbing, and the scaler's pending-rule pre-warm.
+
+Same philosophy as the gateway/scaler suites: replica behavior is
+scripted FakeReplica HTTP, the container half is the fake backend, and
+the state machine under test is the production one end to end."""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kukeon_tpu.gateway.rollout import (
+    RolloutError, RolloutStep, StandbyStep, rolling_restart,
+)
+from kukeon_tpu.runtime import scaler as scaler_mod
+from kukeon_tpu.runtime.errors import FailedPrecondition
+
+from test_gateway import FakeReplica, _free_port_block
+from test_scaler import _autoscaled_doc, _controller, _scaler_rig
+
+
+# --- runner: the parked start/stop primitives --------------------------------
+
+
+def test_start_parked_replica_boots_without_raising_target(tmp_path):
+    ctl, backend, store = _controller(tmp_path)
+    ctl.create_cell(_autoscaled_doc(9300))
+    runner = ctl.runner
+
+    rec, cname = runner.start_parked_replica("default", "default", "default",
+                                             "llm")
+    assert cname == "model-server-1"
+    # The standby is UP, on its pre-partitioned chip grant...
+    assert rec.status.container("model-server-1").state == "running"
+    started = {c.spec.name: c for c in backend.started}
+    assert started["model-server-1"].env["TPU_VISIBLE_DEVICES"] == "1"
+    # ...but the active target is untouched: the scaler/gateway census,
+    # phase derivation, everything still sees one active replica.
+    assert rec.status.target_replicas is None
+    assert runner.model_target(rec) == 1
+    assert rec.status.phase == "ready"
+
+    # Idempotent: a standby already running is adopted, not restarted.
+    n_started = sum(1 for c in backend.started
+                    if c.spec.name == "model-server-1")
+    rec, cname2 = runner.start_parked_replica("default", "default",
+                                              "default", "llm")
+    assert cname2 == "model-server-1"
+    assert sum(1 for c in backend.started
+               if c.spec.name == "model-server-1") == n_started
+
+
+def test_start_parked_replica_requires_parked_capacity(tmp_path):
+    ctl, _backend, _store = _controller(tmp_path)
+    ctl.create_cell(_autoscaled_doc(9300, mx=3))
+    runner = ctl.runner
+    runner.scale_model_cell("default", "default", "default", "llm", 3)
+    with pytest.raises(FailedPrecondition, match="no parked replica"):
+        runner.start_parked_replica("default", "default", "default", "llm")
+
+
+def test_stop_parked_replica_parks_again_but_spares_promoted(tmp_path):
+    ctl, backend, store = _controller(tmp_path)
+    ctl.create_cell(_autoscaled_doc(9300))
+    runner = ctl.runner
+
+    runner.start_parked_replica("default", "default", "default", "llm")
+    rec = runner.stop_parked_replica("default", "default", "default", "llm",
+                                     "model-server-1")
+    assert rec.status.container("model-server-1").state == "exited"
+    assert rec.status.target_replicas is None      # never touched
+
+    # Pre-warm again, then promote it: the scale-up adopts the warm
+    # container in place (no second start), and parking the NAME is now a
+    # silent no-op — the replica is live capacity, not a standby.
+    runner.start_parked_replica("default", "default", "default", "llm")
+    n_started = sum(1 for c in backend.started
+                    if c.spec.name == "model-server-1")
+    rec = runner.scale_model_cell("default", "default", "default", "llm", 2)
+    assert sum(1 for c in backend.started
+               if c.spec.name == "model-server-1") == n_started
+    rec = runner.stop_parked_replica("default", "default", "default", "llm",
+                                     "model-server-1")
+    assert rec.status.container("model-server-1").state == "running"
+    assert runner.model_target(rec) == 2
+
+
+# --- rolling_restart with a standby ------------------------------------------
+
+
+def _ready_count(urls: list[str]) -> int:
+    n = 0
+    for u in urls:
+        try:
+            with urllib.request.urlopen(u + "/readyz", timeout=0.5) as r:
+                n += r.status == 200
+        except Exception:  # noqa: BLE001 — down/draining = not ready
+            pass
+    return n
+
+
+def test_rolling_restart_standby_holds_ready_census():
+    """The acceptance invariant at the state-machine level: with a standby
+    pre-warmed first, the number of /readyz-200 replicas never dips below
+    N (=2) at any instant of a full two-replica rollout."""
+    base = _free_port_block(3)
+    replicas = {0: FakeReplica(port=base), 1: FakeReplica(port=base + 1)}
+    standby: dict[str, FakeReplica | None] = {"r": None}
+    parked = []
+
+    def respawn(i):
+        def _r():
+            replicas[i].kill()           # drained fake freed its port
+            replicas[i] = FakeReplica(port=base + i)
+        return _r
+
+    steps = [RolloutStep(name=f"model-server-{i}", url=replicas[i].url,
+                         restart=respawn(i)) for i in range(2)]
+    sb = StandbyStep(
+        name="model-server-2", url=f"http://127.0.0.1:{base + 2}",
+        start=lambda: standby.__setitem__(
+            "r", FakeReplica(port=base + 2)),
+        stop=lambda: (parked.append(True), standby["r"].kill()))
+
+    urls = [f"http://127.0.0.1:{base + i}" for i in range(3)]
+    census: list[int] = []
+    stop = threading.Event()
+
+    def monitor():
+        while not stop.is_set():
+            census.append(_ready_count(urls))
+            time.sleep(0.02)
+
+    th = threading.Thread(target=monitor)
+    th.start()
+    try:
+        results = rolling_restart(steps, drain_timeout_s=10.0,
+                                  ready_timeout_s=10.0, poll_s=0.05,
+                                  standby=sb)
+    finally:
+        stop.set()
+        th.join(timeout=10)
+        for r in replicas.values():
+            r.kill()
+        if standby["r"] is not None:
+            standby["r"].kill()
+
+    assert [r["replica"] for r in results] == ["model-server-0",
+                                               "model-server-1"]
+    # Every step's record names the standby that covered its window.
+    for r in results:
+        assert r["standby"]["replica"] == "model-server-2"
+        assert r["standby"]["readyS"] >= 0.0
+    assert parked == [True]            # parked again on the way out
+    assert census, "census monitor produced no samples"
+    assert min(census) >= 2, f"ready census dipped to {min(census)}"
+
+
+def test_standby_start_failure_aborts_before_any_drain():
+    a, b = FakeReplica(), FakeReplica()
+    steps = [RolloutStep(name="model-server-0", url=a.url,
+                         restart=lambda: None),
+             RolloutStep(name="model-server-1", url=b.url,
+                         restart=lambda: None)]
+    sb = StandbyStep(name="model-server-2", url="http://127.0.0.1:1",
+                     start=lambda: (_ for _ in ()).throw(
+                         RuntimeError("no parked capacity")),
+                     stop=lambda: None)
+    try:
+        with pytest.raises(RolloutError, match="rollout not begun") as ei:
+            rolling_restart(steps, drain_timeout_s=5.0, ready_timeout_s=5.0,
+                            standby=sb)
+        assert ei.value.results == [
+            {"replica": "model-server-2", "standby": True,
+             "error": "start failed: RuntimeError: no parked capacity"}]
+        # No victim was drained: the fleet is exactly as it was.
+        assert not a.draining and not b.draining
+    finally:
+        a.kill()
+        b.kill()
+
+
+def test_standby_never_ready_aborts_and_parks():
+    a = FakeReplica()
+    steps = [RolloutStep(name="model-server-0", url=a.url,
+                         restart=lambda: None)]
+    parked = []
+    sb = StandbyStep(name="model-server-1", url="http://127.0.0.1:1",
+                     start=lambda: None,      # "starts" but never listens
+                     stop=lambda: parked.append(True))
+    try:
+        with pytest.raises(RolloutError,
+                           match="did not become ready") as ei:
+            rolling_restart(steps, drain_timeout_s=5.0, ready_timeout_s=0.4,
+                            poll_s=0.05, standby=sb)
+        assert ei.value.results[0]["standby"] is True
+        assert parked == [True]        # best-effort park even on abort
+        assert not a.draining
+    finally:
+        a.kill()
+
+
+# --- RolloutCell RPC: the full plumbing --------------------------------------
+
+
+def _rollout_rig(tmp_path, monkeypatch, doc_port, doc):
+    """Controller + two live FakeReplicas + the respawning restart shim
+    (the same pattern as the gateway flood test)."""
+    from kukeon_tpu.runtime import daemon as dmod
+
+    ctl, backend, store = _controller(tmp_path)
+    ctl.create_cell(doc)
+    replicas = {0: FakeReplica(port=doc_port + 1),
+                1: FakeReplica(port=doc_port + 2)}
+    real_restart = dmod._rollout_restart
+
+    def restart_and_respawn(ctl_, rec, cname):
+        i = int(cname.rsplit("-", 1)[1])
+        replicas[i].kill()
+        cdir = store.container_dir(rec.realm, rec.space, rec.stack,
+                                   rec.name, cname)
+        backend.exit(cdir, 0)
+        real_restart(ctl_, rec, cname)
+        replicas[i] = FakeReplica(port=doc_port + 1 + i)
+
+    monkeypatch.setattr(dmod, "_rollout_restart", restart_and_respawn)
+    return ctl, backend, store, dmod.RPCService(ctl), replicas
+
+
+def test_rollout_cell_standby_prewarms_and_parks(tmp_path, monkeypatch):
+    base = _free_port_block(4)
+    ctl, backend, store, service, replicas = _rollout_rig(
+        tmp_path, monkeypatch, base,
+        _autoscaled_doc(base, replicas=2, mx=3))
+    # The parked replica's HTTP face: the fake backend starts no real
+    # process, so the standby's server rides separately like every
+    # FakeReplica — listening before the RPC probes its /readyz.
+    sb = FakeReplica(port=base + 3)
+    try:
+        out = service.RolloutCell("default", "default", "default", "llm",
+                                  drainTimeoutS=15.0, readyTimeoutS=15.0)
+    finally:
+        sb.kill()
+        for r in replicas.values():
+            r.kill()
+
+    assert "aborted" not in out
+    assert [r["replica"] for r in out["replicas"]] == [
+        "model-server-0", "model-server-1"]
+    for r in out["replicas"]:
+        assert r["standby"]["replica"] == "model-server-2"
+    # The standby container really started — and was parked again.
+    rec = store.read_cell("default", "default", "default", "llm")
+    assert rec.status.container("model-server-2").state == "exited"
+    assert ctl.runner.model_target(rec) == 2       # target never touched
+    assert rec.status.container("model-server-0").restarts == 1
+    assert rec.status.container("model-server-1").restarts == 1
+
+
+def test_rollout_cell_standby_false_skips_prewarm(tmp_path, monkeypatch):
+    base = _free_port_block(4)
+    _ctl, backend, _store, service, replicas = _rollout_rig(
+        tmp_path, monkeypatch, base,
+        _autoscaled_doc(base, replicas=2, mx=3))
+    try:
+        out = service.RolloutCell("default", "default", "default", "llm",
+                                  drainTimeoutS=15.0, readyTimeoutS=15.0,
+                                  standby=False)
+    finally:
+        for r in replicas.values():
+            r.kill()
+    assert "aborted" not in out
+    assert all("standby" not in r for r in out["replicas"])
+    assert not any(c.spec.name == "model-server-2" for c in backend.started)
+
+
+def test_rollout_cell_no_parked_capacity_rolls_without_standby(
+        tmp_path, monkeypatch):
+    """A plain replicated cell (no maxReplicas) has nothing to pre-warm:
+    the default standby=True is a request, not a requirement — the rollout
+    proceeds exactly as before the standby existed."""
+    from kukeon_tpu.runtime.api import types as t
+
+    base = _free_port_block(3)
+    doc = t.Document(
+        kind=t.KIND_CELL, metadata=t.Metadata(name="llm"),
+        spec=t.CellSpec(model=t.ModelSpec(model="tiny", chips=1,
+                                          replicas=2, port=base)))
+    _ctl, _backend, _store, service, replicas = _rollout_rig(
+        tmp_path, monkeypatch, base, doc)
+    try:
+        out = service.RolloutCell("default", "default", "default", "llm",
+                                  drainTimeoutS=15.0, readyTimeoutS=15.0)
+    finally:
+        for r in replicas.values():
+            r.kill()
+    assert "aborted" not in out
+    assert all("standby" not in r for r in out["replicas"])
+
+
+# --- scaler pre-warm ---------------------------------------------------------
+
+
+def test_scaler_prewarms_on_pending_before_the_scale_up(tmp_path,
+                                                        monkeypatch):
+    ctl, store, sc, clock, tick = _scaler_rig(tmp_path, monkeypatch)
+    calls = []
+    real_prewarm = scaler_mod._prewarm_replica
+
+    def prewarm_and_count(ctl_, rec):
+        calls.append(rec.name)
+        real_prewarm(ctl_, rec)
+
+    monkeypatch.setattr(scaler_mod, "_prewarm_replica", prewarm_and_count)
+
+    # First breaching tick: the up rule is PENDING — no scale-up yet, but
+    # the pre-warm already booted the next parked replica.
+    assert tick(9.0) == []
+    assert calls == ["llm"]
+    rec = store.read_cell("default", "default", "default", "llm")
+    assert ctl.runner.model_target(rec) == 1
+    assert rec.status.container("model-server-1").state == "running"
+
+    # The debounced scale-up then promotes the WARM standby in place.
+    evs = tick(9.0)
+    assert [(e["direction"], e["to"]) for e in evs] == [("up", 2)]
+    rec = store.read_cell("default", "default", "default", "llm")
+    assert ctl.runner.model_target(rec) == 2
+    assert rec.status.container("model-server-1").state == "running"
+
+
+def test_scaler_prewarm_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(scaler_mod.PREWARM_ENV, "0")
+    ctl, store, sc, clock, tick = _scaler_rig(tmp_path, monkeypatch)
+    assert sc.prewarm is False
+    assert tick(9.0) == []             # pending, and nothing pre-warmed
+    rec = store.read_cell("default", "default", "default", "llm")
+    c = rec.status.container("model-server-1")
+    assert c is None or c.state != "running"
+
+
+def test_scaler_prewarm_failure_degrades_to_cold_promotion(tmp_path,
+                                                           monkeypatch):
+    ctl, store, sc, clock, tick = _scaler_rig(tmp_path, monkeypatch)
+    monkeypatch.setattr(
+        scaler_mod, "_prewarm_replica",
+        lambda ctl_, rec: (_ for _ in ()).throw(RuntimeError("boom")))
+    assert tick(9.0) == []             # the failed pre-warm is swallowed
+    evs = tick(9.0)                    # ...and the scale-up still lands
+    assert [(e["direction"], e["result"], e["to"]) for e in evs] == [
+        ("up", "ok", 2)]
+
+
+# --- CLI ---------------------------------------------------------------------
+
+
+def test_cli_rollout_standby_flag_and_printing(monkeypatch, capsys):
+    from kukeon_tpu.runtime import cli
+
+    parser = cli.build_parser()
+    assert parser.parse_args(["rollout", "llm"]).standby is True
+    args = parser.parse_args(["rollout", "llm", "--no-standby"])
+    assert args.standby is False
+
+    seen = {}
+    out = {"cell": "default/default/default/llm", "replicas": [
+        {"replica": "model-server-0", "drained": True, "readyS": 0.2,
+         "standby": {"replica": "model-server-2", "readyS": 1.5}},
+        {"replica": "model-server-1", "drained": True, "readyS": 0.3,
+         "standby": {"replica": "model-server-2", "readyS": 1.5}},
+    ]}
+
+    class _Client:
+        def call(self, method, **params):
+            assert method == "RolloutCell"
+            seen.update(params)
+            return out
+
+    monkeypatch.setattr(cli, "_client", lambda a: _Client())
+    args = argparse.Namespace(name="llm", json=False, realm=None, space=None,
+                              stack=None, drain_timeout=5.0,
+                              ready_timeout=5.0, standby=False)
+    assert cli.cmd_rollout(args) == 0
+    assert seen["standby"] is False
+    text = capsys.readouterr().out
+    assert "standby model-server-2: ready in 1.5s" in text
+    assert "census held at N" in text
+
+    # A standby that failed before any drain prints as its own FAILED row
+    # (the record has no drain/ready fields to format).
+    out2 = {"cell": "default/default/default/llm", "aborted": True,
+            "error": "standby model-server-2 failed to start",
+            "replicas": [{"replica": "model-server-2", "standby": True,
+                          "error": "start failed: RuntimeError: boom"}]}
+
+    class _Client2:
+        def call(self, method, **params):
+            return out2
+
+    monkeypatch.setattr(cli, "_client", lambda a: _Client2())
+    assert cli.cmd_rollout(args) == 1
+    text = capsys.readouterr().out
+    assert "standby model-server-2: FAILED: start failed" in text

@@ -659,6 +659,81 @@ def test_kuke012_covers_serving_cell_kv_helpers(tmp_path):
     assert found[0].scope == "pack_kv"
 
 
+# --- KUKE013: control-plane boot imports -------------------------------------
+
+
+def _runtime_repo(tmp_path, files: dict[str, str]):
+    """Like _mini_repo but the package dir is literally ``kukeon_tpu`` —
+    KUKE013 scopes by the real control-plane path (kukeon_tpu/runtime/),
+    so the fixture tree must carry the same prefix."""
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    (tmp_path / "README.md").write_text("docs\n")
+    pkg = tmp_path / "kukeon_tpu"
+    for rel, body in files.items():
+        path = pkg / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(body))
+    return str(pkg)
+
+
+def test_kuke013_flags_heavy_module_and_class_scope_imports(tmp_path):
+    pkg = _runtime_repo(tmp_path, {"runtime/daemon.py": '''
+        import os                                  # light: fine
+        import jax                                 # heavy, module scope
+        from kukeon_tpu.models import llama        # heavy, module scope
+
+        class RPCService:
+            import jax.numpy as jnp                # class body runs at import
+
+            def handler(self):
+                from kukeon_tpu import serving     # lazy: the fix, silent
+                return serving
+    '''})
+    found = run_analysis(pkg, select=["KUKE013"])
+    assert sorted(f.detail for f in found) == [
+        "import:jax", "import:jax.numpy", "import:kukeon_tpu.models"]
+    assert all(f.rule == "KUKE013" for f in found)
+    by_detail = {f.detail: f for f in found}
+    assert by_detail["import:jax"].scope == "<module>"
+    assert by_detail["import:jax.numpy"].scope == "RPCService"
+
+
+def test_kuke013_from_package_binding_counts_as_heavy(tmp_path):
+    # `from kukeon_tpu import serving` binds the whole heavy package just
+    # as surely as `import kukeon_tpu.serving` does.
+    pkg = _runtime_repo(tmp_path, {"runtime/cli.py": '''
+        from kukeon_tpu import serving
+    '''})
+    found = run_analysis(pkg, select=["KUKE013"])
+    assert [f.detail for f in found] == ["import:kukeon_tpu.serving"]
+
+
+def test_kuke013_silent_on_lazy_imports_and_exempt_files(tmp_path):
+    pkg = _runtime_repo(tmp_path, {
+        # Control plane done right: heavy imports only inside functions.
+        "runtime/scaler.py": '''
+            import os
+            import threading
+
+            def tick():
+                import jax
+                from kukeon_tpu.serving import engine
+                return jax, engine
+        ''',
+        # The data-plane process: heavy module-scope imports deliberate,
+        # measured as the boot_imports cold-start phase.
+        "runtime/serving_cell.py": '''
+            import jax
+            from kukeon_tpu.models import llama
+        ''',
+        # Outside the control plane: KUKE001/002's territory, not ours.
+        "serving/engine.py": '''
+            import jax
+        ''',
+    })
+    assert run_analysis(pkg, select=["KUKE013"]) == []
+
+
 # --- baseline suppression ----------------------------------------------------
 
 
@@ -739,7 +814,7 @@ def test_all_rules_are_registered():
     assert registered_rules() == (
         "KUKE001", "KUKE002", "KUKE003", "KUKE004",
         "KUKE005", "KUKE006", "KUKE007", "KUKE008", "KUKE009",
-        "KUKE010", "KUKE011", "KUKE012",
+        "KUKE010", "KUKE011", "KUKE012", "KUKE013",
     )
 
 

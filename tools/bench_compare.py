@@ -3,7 +3,7 @@
 previous round and flag regressions.
 
 The bench artifacts (`bench.py --out BENCH_rNN.json`, schema
-kukeon-bench/v1..v5) are the repo's performance trajectory; this tool is
+kukeon-bench/v1..v6) are the repo's performance trajectory; this tool is
 the cheap guard that a round did not silently give back throughput,
 latency, cold start, or HBM headroom:
 
@@ -33,7 +33,7 @@ import re
 import sys
 
 SCHEMAS = ("kukeon-bench/v1", "kukeon-bench/v2", "kukeon-bench/v3",
-           "kukeon-bench/v4", "kukeon-bench/v5")
+           "kukeon-bench/v4", "kukeon-bench/v5", "kukeon-bench/v6")
 
 # (label, path into the artifact, direction: +1 = higher is better)
 METRICS = (
@@ -47,6 +47,13 @@ METRICS = (
     ("handoff p50 (ms)", ("handoff_ms_p50",), -1),
     ("e2e p95 (s)", ("latency_s", "e2e", "p95"), -1),
     ("cold start p50 (s)", ("cold_start", "p50_s"), -1),
+    # v6: the streamed-boot load sub-phases (work-time medians off the
+    # cell's own gauges). These overlap each other and compile, so a
+    # regression in any one of them names WHICH leg of the boot pipeline
+    # got slower even when the overlapped total hides it.
+    ("cold disk (s)", ("cold_start", "load_s", "disk"), -1),
+    ("cold cast (s)", ("cold_start", "load_s", "cast"), -1),
+    ("cold upload (s)", ("cold_start", "load_s", "upload"), -1),
     ("peak HBM (bytes)", ("peak_hbm_bytes",), -1),
     # v5: the diurnal ramp's headline numbers — the peak stage's client
     # p95 (the latency the spillover queue trades a shed storm for) and
@@ -58,7 +65,7 @@ METRICS = (
 
 def read_artifact(path: str) -> dict | None:
     """A BENCH_rNN.json if it is a bench artifact (any schema version),
-    upgraded to the v5 shape; None for the early raw-transcript rounds."""
+    upgraded to the v6 shape; None for the early raw-transcript rounds."""
     try:
         with open(path) as f:
             artifact = json.load(f)
@@ -66,7 +73,7 @@ def read_artifact(path: str) -> dict | None:
         return None
     if not isinstance(artifact, dict) or artifact.get("schema") not in SCHEMAS:
         return None
-    if artifact["schema"] != "kukeon-bench/v5":
+    if artifact["schema"] != "kukeon-bench/v6":
         artifact = dict(artifact)
         artifact.setdefault("replicas", 1)
         artifact.setdefault("kv_page_tokens", 0)
@@ -76,7 +83,10 @@ def read_artifact(path: str) -> dict | None:
         artifact.setdefault("handoff_ms_p50", None)
         artifact.setdefault("disagg", None)
         artifact.setdefault("diurnal", None)
-        artifact["schema"] = "kukeon-bench/v5"
+        if isinstance(artifact.get("cold_start"), dict):
+            artifact["cold_start"] = dict(artifact["cold_start"])
+            artifact["cold_start"].setdefault("load_s", None)
+        artifact["schema"] = "kukeon-bench/v6"
     return artifact
 
 
