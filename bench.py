@@ -150,8 +150,15 @@ def phase_serve(args) -> None:
 
     backend = jax.default_backend()
     n_chips = len(jax.devices())
-    shape = auto_mesh_shape(n_chips)
-    mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
+    if args.chips:
+        # Sharding-layout arm: exactly N chips, all on the tensor axis
+        # (over-asking the host fails loudly in serving_mesh).
+        from kukeon_tpu.parallel import serving_mesh
+
+        mesh = serving_mesh(args.chips)
+    else:
+        shape = auto_mesh_shape(n_chips)
+        mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
 
     if args.checkpoint:
         params, cfg = checkpoints.load_quantized(args.checkpoint)
@@ -172,6 +179,9 @@ def phase_serve(args) -> None:
         cfg, params, mesh, num_slots=sessions, max_seq_len=max_seq,
         decode_chunk=args.decode_chunk, kv_cache_int8=args.kv_int8,
         prefill_buckets=buckets, kv_page_tokens=args.kv_page_tokens or 0,
+        # auto = the engine's divisibility default (then the tune profile);
+        # on/off pin the KV-pool layout for a sharding-sweep arm.
+        kv_shard={"auto": None, "on": True, "off": False}[args.kv_shard],
     )
 
     _LAT_HISTS = (("ttft", "kukeon_engine_ttft_seconds"),
@@ -266,12 +276,22 @@ def phase_serve(args) -> None:
         "compiles": compiles,
         "peak_hbm_bytes": peak_hbm,
         "kv_page_tokens": engine.page_tokens,
+        # The mesh this measurement ran on: chips, the tensor-axis size,
+        # and whether the KV pool actually sharded over it (the engine may
+        # replicate on a head-divisibility miss even when asked to shard).
+        "mesh": {
+            "chips": int(mesh.size),
+            "tensor": int(mesh.shape["tensor"]),
+            "kv_sharded": bool(any(engine._cache_shardings()[0].spec)),
+        },
         "config": {
             "decode_chunk": engine.decode_chunk,
             "kv_cache_int8": engine.kv_cache_int8,
             "prefill_buckets": (list(engine.prefill_buckets)
                                 if buckets else None),
             "kv_page_tokens": engine.page_tokens,
+            "chips": args.chips,
+            "kv_shard": args.kv_shard,
         },
     }), flush=True)
 
@@ -1018,6 +1038,17 @@ def phase_autotune(args) -> None:
         arms.append((f"chunk{chunks[-1]}+paged{pt}",
                      {"decode_chunk": chunks[-1], "kv_int8": False,
                       "prefill_buckets": None, "kv_page_tokens": pt}))
+    # Sharding-layout arms (the multi-chip sweep): every tensor-axis size
+    # this host can factor (divisors of the chip count, capped at one ICI
+    # ring) × KV pool sharded vs replicated. Size 1 is the baseline the
+    # arms above already measure; a single-chip host grows no arms.
+    for ms in (d for d in (2, 4, 8) if d <= n_chips and n_chips % d == 0):
+        for kv in ("on", "off"):
+            arms.append(
+                (f"chunk{chunks[-1]}+mesh{ms}"
+                 + ("+kvshard" if kv == "on" else "+kvrepl"),
+                 {"decode_chunk": chunks[-1], "kv_int8": False,
+                  "prefill_buckets": None, "chips": ms, "kv_shard": kv}))
 
     results: dict = {}
     best_name, best_cfg, best_rate = None, None, -1.0
@@ -1030,6 +1061,9 @@ def phase_autotune(args) -> None:
             cmd += ["--prefill-buckets", cfg["prefill_buckets"]]
         if cfg.get("kv_page_tokens"):
             cmd += ["--kv-page-tokens", str(cfg["kv_page_tokens"])]
+        if cfg.get("chips"):
+            cmd += ["--chips", str(cfg["chips"]),
+                    "--kv-shard", cfg.get("kv_shard", "auto")]
         if qdir:
             cmd += ["--checkpoint", qdir]
         try:
@@ -1051,7 +1085,8 @@ def phase_autotune(args) -> None:
         # not just what it buys in throughput.
         results[name] = {"tok_per_s": round(rate, 2),
                          "trials": serve["trials"],
-                         "latency_s": serve.get("latency_s")}
+                         "latency_s": serve.get("latency_s"),
+                         "mesh": serve.get("mesh")}
         _log(f"autotune arm {name}: {results[name]}")
         if rate > best_rate:
             best_name, best_cfg, best_rate = name, cfg, rate
@@ -1073,6 +1108,11 @@ def phase_autotune(args) -> None:
             kv_cache_int8=best_cfg["kv_int8"],
             prefill_buckets=buckets,
             kv_page_tokens=best_cfg.get("kv_page_tokens"),
+            # Sharding layout of the winner: absent fields keep whatever
+            # the cell's chip grant / divisibility default dictates.
+            mesh_tensor=best_cfg.get("chips"),
+            kv_shard={"on": True, "off": False}.get(
+                best_cfg.get("kv_shard")),
             tok_per_s=best_rate,
         ))
         line["best"] = {"arm": best_name, "tok_per_s": round(best_rate, 2)}
@@ -1340,6 +1380,13 @@ def main() -> None:
     # Paged KV cache page size (serving/kv_pages.py): 0/absent = legacy
     # contiguous layout; > 0 = block-table page pool with this page size.
     ap.add_argument("--kv-page-tokens", type=int, default=None)
+    # Sharding layout (serve phase): exact N-chip tensor-parallel mesh
+    # (absent = every visible device, auto-factorized) and whether the KV
+    # pool shards over the tensor axis (auto = the engine's divisibility
+    # default). The autotune sweep drives both.
+    ap.add_argument("--chips", type=int, default=None)
+    ap.add_argument("--kv-shard", choices=("auto", "on", "off"),
+                    default="auto")
     # Fast mode: measure the streamed-boot cold start ONLY (fresh daemon ->
     # apply -> first health, with the disk/cast/upload/compile breakdown
     # off the cell's own gauges) and skip the serve/flood phases entirely —
@@ -1348,8 +1395,8 @@ def main() -> None:
     ap.add_argument("--cold-runs", type=int, default=None,
                     help="override the number of cold-start runs")
     # Standardized trajectory artifact (e.g. --out BENCH_r06.json): one
-    # schema-versioned JSON file per run (kukeon-bench/v6; read_artifact
-    # upgrades v1-v5 points) with percentiles, throughput, compile counts,
+    # schema-versioned JSON file per run (kukeon-bench/v7; read_artifact
+    # upgrades v1-v6 points) with percentiles, throughput, compile counts,
     # peak HBM, replica count, and the disaggregation + diurnal sections,
     # so BENCH_*.json points stay comparable across rounds regardless of
     # how the console line evolves.
@@ -1542,15 +1589,18 @@ def read_artifact(path: str) -> dict:
     ``diurnal: None`` (no diurnal-ramp phase existed); v1–v5 points
     (pre-streamed-boot) gain ``cold_start.load_s: None`` (no disk / cast /
     upload sub-phase ledger existed before the streamed checkpoint
-    pipeline)."""
+    pipeline); v1–v6 points (pre-multi-chip) gain ``mesh: None`` (the
+    measurement ran before the sharded serving mesh existed — a v7 point
+    always records its mesh layout, single-chip included)."""
     with open(path) as f:
         artifact = json.load(f)
     schema = artifact.get("schema")
     if schema not in ("kukeon-bench/v1", "kukeon-bench/v2",
                       "kukeon-bench/v3", "kukeon-bench/v4",
-                      "kukeon-bench/v5", "kukeon-bench/v6"):
+                      "kukeon-bench/v5", "kukeon-bench/v6",
+                      "kukeon-bench/v7"):
         raise ValueError(f"unknown bench artifact schema {schema!r} in {path}")
-    if schema != "kukeon-bench/v6":
+    if schema != "kukeon-bench/v7":
         artifact = dict(artifact)
         artifact.setdefault("replicas", 1)              # v1 -> v2
         artifact.setdefault("kv_page_tokens", 0)        # v2 -> v3
@@ -1563,7 +1613,8 @@ def read_artifact(path: str) -> dict:
         if isinstance(artifact.get("cold_start"), dict):    # v5 -> v6
             artifact["cold_start"] = dict(artifact["cold_start"])
             artifact["cold_start"].setdefault("load_s", None)
-        artifact["schema"] = "kukeon-bench/v6"
+        artifact.setdefault("mesh", None)               # v6 -> v7
+        artifact["schema"] = "kukeon-bench/v7"
     return artifact
 
 
@@ -1571,7 +1622,7 @@ def write_artifact(path: str, serve: dict, result: dict) -> None:
     """The standardized BENCH_rNN.json trajectory point: fixed schema, one
     file per run, every field from the product's own instruments."""
     artifact = {
-        "schema": "kukeon-bench/v6",
+        "schema": "kukeon-bench/v7",
         "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "backend": serve["backend"],
         "n_chips": serve["n_chips"],
@@ -1610,6 +1661,10 @@ def write_artifact(path: str, serve: dict, result: dict) -> None:
         "cold_start": result.get("cold_start"),
         "embedding": result.get("embedding"),
         "mixed": result.get("mixed"),
+        # v7: the serving-mesh layout the measurement ran on (chips,
+        # tensor-axis size, whether the KV pool sharded); None only for
+        # phases that never built an engine (e.g. --cold-start-only).
+        "mesh": serve.get("mesh"),
     }
     # v6: cold_start carries the streamed-load sub-phase ledger (disk /
     # cast / upload medians); explicit None when the boot exported none.

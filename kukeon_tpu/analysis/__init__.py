@@ -25,6 +25,9 @@ KUKE010  span phase/mark literal not declared in ``obs/trace.py`` PHASES
 KUKE011  built-in alert rule references a metric family no module declares
 KUKE012  raw device transfer in KV export/import (handoff) code outside the
          counted ``_fetch``/``_upload``/``sanitize.blocking`` seams
+KUKE013  heavy module-scope import in a control-plane runtime module
+KUKE014  jitted program compiled without explicit ``in_shardings`` /
+         ``out_shardings`` (implicit GSPMD placement on a mesh engine)
 ======== =====================================================================
 
 Zero-dependency by design (stdlib ``ast`` only): importable and runnable

@@ -1,4 +1,5 @@
-"""KUKE003/KUKE004 — jit-stability of the engine's compiled programs.
+"""KUKE003/KUKE004/KUKE014 — jit-stability and placement of the engine's
+compiled programs.
 
 The engine's performance story rests on "decode never recompiles": its
 jitted programs are built once in ``_build_programs`` and every dispatch
@@ -20,7 +21,18 @@ must hit the tracing cache. Two statically-checkable ways to break that:
   it is a silent staleness bug — the compiled program keeps the value the
   first trace saw. Only the declared frozen allowlist may appear.
 
-Both rules are scoped to ``serving/engine.py``'s ``ServingEngine``: the
+A third statically-checkable property guards the multi-chip story:
+
+- **KUKE014 — implicit placement on a mesh-enabled engine.** The engine
+  serves on an explicit mesh (1..N chips); a ``jax.jit`` without
+  ``in_shardings``/``out_shardings`` leaves placement to GSPMD inference,
+  which can silently replicate a sharded KV pool (N× HBM) or insert a
+  resharding transfer on the decode path. Every jitted-program definition
+  in ``_build_programs`` must pass BOTH keywords — replication is fine,
+  but it must be spelled (``NamedSharding(mesh, PartitionSpec())``), never
+  defaulted.
+
+All rules are scoped to ``serving/engine.py``'s ``ServingEngine``: the
 pass reads ``_build_programs`` to learn which inner functions are jitted
 (and their ``static_argnums``), then checks every call site of the seven
 ``self._<program>`` attributes across the class (including the
@@ -172,6 +184,50 @@ def check_jit_stability(sources: Sequence[SourceFile],
                                 f"length) — pass an array",
                                 scope=f"{cls.name}.{meth.name}",
                                 detail=f"{prog}[{i}]"))
+    return findings
+
+
+@register_pass(("KUKE014",))
+def check_jit_shardings(sources: Sequence[SourceFile],
+                        package_root: str) -> list[Finding]:
+    """Every jitted-program definition must place its data explicitly."""
+    findings: list[Finding] = []
+    for src in sources:
+        if not src.rel.endswith(ENGINE_FILE_SUFFIX):
+            continue
+        for cls in src.tree.body:
+            if not (isinstance(cls, ast.ClassDef)
+                    and cls.name == ENGINE_CLASS):
+                continue
+            build = next(
+                (m for m in cls.body if isinstance(m, ast.FunctionDef)
+                 and m.name == "_build_programs"), None)
+            if build is None:
+                continue
+            for node in ast.walk(build):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                target = node.targets[0]
+                if not (is_self_attr(target)
+                        and target.attr in JITTED_PROGRAMS):
+                    continue
+                jit_call = _find_jit_call(node.value)
+                if jit_call is None:
+                    continue
+                present = {kw.arg for kw in jit_call.keywords}
+                missing = [k for k in ("in_shardings", "out_shardings")
+                           if k not in present]
+                if missing:
+                    findings.append(Finding(
+                        "KUKE014", src.rel, jit_call.lineno,
+                        f"jitted program {target.attr} is compiled without "
+                        f"explicit {' / '.join(missing)}: on a multi-chip "
+                        f"mesh GSPMD would infer placement (silent KV-pool "
+                        f"replication or decode-path resharding) — spell "
+                        f"the sharding, using NamedSharding(mesh, "
+                        f"PartitionSpec()) for intentional replication",
+                        scope=f"{cls.name}._build_programs",
+                        detail=target.attr))
     return findings
 
 

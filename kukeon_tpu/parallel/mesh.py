@@ -119,8 +119,19 @@ def shard_map(fn, *, mesh, in_specs, out_specs, axis_names=None):
 
 
 def serving_mesh(n_devices: int | None = None) -> Mesh:
-    """All chips on ``tensor`` — the latency-optimal layout for one model."""
-    n = n_devices if n_devices is not None else len(jax.devices())
+    """All chips on ``tensor`` — the latency-optimal layout for one model.
+
+    ``n_devices`` is a hard request, not a hint: asking for more chips than
+    the process can see fails loudly here (a ``chips: N`` grant that cannot
+    be honored must die at boot, never silently serve on fewer chips)."""
+    visible = len(jax.devices())
+    n = n_devices if n_devices is not None else visible
+    if n < 1:
+        raise ValueError(f"serving mesh needs >= 1 device, got {n}")
+    if n > visible:
+        raise ValueError(
+            f"serving mesh wants {n} chips but only {visible} visible "
+            "(check the cell's chip grant / TPU_VISIBLE_DEVICES)")
     return make_mesh(tensor=n, devices=jax.devices()[:n])
 
 
@@ -138,12 +149,19 @@ def largest_pow2_leq(n: int) -> int:
 
 
 def auto_mesh_shape(n_devices: int) -> dict[str, int]:
-    """Heuristic serving layout: tensor up to 8 (one ICI ring), data beyond."""
-    tensor = min(8, largest_pow2_leq(n_devices))
-    data = n_devices // tensor
-    if tensor * data != n_devices:
-        tensor, data = n_devices, 1
-    return {"data": data, "tensor": tensor}
+    """Heuristic serving layout: tensor up to 8 (one ICI ring), data beyond.
+
+    ``data * tensor == n_devices`` always — a non-power-of-two count picks
+    its largest divisor <= 8 for the tensor axis (6 chips -> tensor=6,
+    12 -> tensor=6 x data=2) instead of truncating to a power of two and
+    dropping chips. A prime count degenerates to tensor=n_devices, which
+    is still every chip; callers that need a specific slice size say so
+    via :func:`serving_mesh` and get a loud error instead."""
+    if n_devices < 1:
+        raise ValueError(f"auto_mesh_shape needs >= 1 device, got {n_devices}")
+    tensor = max(d for d in range(1, min(8, n_devices) + 1)
+                 if n_devices % d == 0)
+    return {"data": n_devices // tensor, "tensor": tensor}
 
 
 def axis_size(axis_name) -> int:

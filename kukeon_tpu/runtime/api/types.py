@@ -162,6 +162,11 @@ class ModelSpec:
     analog; no reference equivalent — kukeon has no model cells)."""
 
     model: str = ""                  # e.g. "llama3-8b", "llama3-1b", "tiny"
+    # Chips per replica. 1 = single-chip (the classic shape); N > 1 builds
+    # an N-chip tensor-parallel serving mesh inside each replica (params +
+    # KV pool sharded over the tensor axis). The runner checks at start
+    # that N divides the host's chip count so every replica's grant is a
+    # whole N-chip slice; validate keeps the static >= 1 floor.
     chips: int = 1
     port: int = 9000
     # Scale-out: N > 1 materializes N serving containers (each granted

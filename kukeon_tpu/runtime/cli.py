@@ -713,6 +713,16 @@ def render_top(rows, sparks=None) -> str:
             _fmt_ms(r.get("ttftP50S")), _fmt_ms(r.get("ttftP95S")),
             r.get("queueDepth", "-"), hbm, r.get("restarts", 0))
             + exemplar)
+        if r.get("meshChips", 1) > 1 and r.get("hbmPerDevice"):
+            # Sharded cell: one line per chip of the serving mesh. The
+            # aggregate HBM cell above hides shard skew — a single chip
+            # near its limit OOMs the whole mesh, so show each one with
+            # its high-water mark.
+            for dev, h in r["hbmPerDevice"].items():
+                lines.append(
+                    f"  chip {dev}: hbm {_fmt_bytes(h.get('inUse'))}"
+                    f"/{_fmt_bytes(h.get('limit'))}"
+                    f" peak {_fmt_bytes(h.get('peak'))}")
         sp = (sparks or {}).get(r["cell"])
         if sp:
             lines.append("  {:<30} qps {:<12} p95 {:<12} queue {:<12}".format(

@@ -371,6 +371,30 @@ def summarize_cell_scrape(fams: dict) -> dict:
         v = _sample_sum(fams, name)
         if v is not None:
             out[key] = int(v)
+    # Per-chip breakdown: the device collector already labels every HBM
+    # sample with {device=}; federate those labels instead of collapsing
+    # them so `kuke top` can show each chip of a sharded cell (a skewed
+    # shard is invisible in the aggregate). Aggregate keys above stay —
+    # single-chip rows and the alert rules keep reading them.
+    per_device: dict[str, dict] = {}
+    for key, name in (("inUse", "kukeon_hbm_bytes_in_use"),
+                      ("limit", "kukeon_hbm_bytes_limit"),
+                      ("peak", "kukeon_hbm_bytes_peak")):
+        fam = fams.get(name)
+        if fam is None:
+            continue
+        for _n, labels, value in fam.samples:
+            dev = labels.get("device")
+            if dev is not None:
+                per_device.setdefault(dev, {})[key] = int(value)
+    if per_device:
+        out["hbmPerDevice"] = {
+            d: per_device[d]
+            for d in sorted(per_device, key=lambda x: (len(x), x))
+        }
+    mesh = _sample_value(fams, "kukeon_engine_mesh_chips")
+    if mesh is not None:
+        out["meshChips"] = int(mesh)
     burn = _sample_value(fams, "kukeon_slo_burn_rate",
                          slo="availability", window="1h")
     if burn is not None:

@@ -364,6 +364,12 @@ class Runner:
             cmd += ["--slo-ttft-p95-ms", str(m.slo_ttft_p95_ms)]
         if m.slo_availability:
             cmd += ["--slo-availability", str(m.slo_availability)]
+        # The chip grant is always explicit: the cell builds an exactly-N
+        # serving mesh (parallel/mesh.serving_mesh) instead of auto-meshing
+        # over whatever it can see. On TPU hosts TPU_VISIBLE_DEVICES already
+        # narrows visibility to the grant; on CPU hosts (forced multi-device
+        # smokes) this flag is the only thing that makes the grant real.
+        cmd += ["--chips", str(m.chips)]
         return t.ContainerSpec(
             name=name,
             command=cmd,
@@ -419,6 +425,18 @@ class Runner:
 
     def _start_cell_locked(self, rec: model.CellRecord) -> model.CellRecord:
         containers = self.cell_containers(rec)
+        # Multi-chip composition check (validate_cell is static and cannot
+        # see the host): a grant that does not divide the host's chip count
+        # can never partition into whole N-chip replica slices — fail loudly
+        # here instead of letting a later replica starve mid-scale-up.
+        m = rec.spec.model
+        host_chips = len(self.devices.chips)
+        if m is not None and m.chips > 1 and host_chips % m.chips:
+            raise FailedPrecondition(
+                f"model chip grant chips={m.chips} does not divide this "
+                f"host's {host_chips} chips; replicas cannot partition into "
+                "whole slices"
+            )
         total_chips = sum(
             c.resources.tpu_chips or 0 for c in containers
         )

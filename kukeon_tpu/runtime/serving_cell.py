@@ -392,7 +392,8 @@ class ServingCell(LifecycleMixin):
                  deadline_s: float | None = None,
                  slo_ttft_p95_ms: float | None = None,
                  slo_availability: float | None = None,
-                 role: str = "mixed"):
+                 role: str = "mixed",
+                 chips: int | None = None):
         # Cold-start phase marks (monotonic). "boot_imports" is everything
         # between process start and constructor entry — interpreter boot,
         # module imports, argparse; the remaining phases are stamped as
@@ -403,7 +404,7 @@ class ServingCell(LifecycleMixin):
         _enable_compilation_cache()
 
         from kukeon_tpu.models import llama
-        from kukeon_tpu.parallel import auto_mesh_shape, make_mesh
+        from kukeon_tpu.parallel import auto_mesh_shape, make_mesh, serving_mesh
         from kukeon_tpu.serving import ServingEngine
 
         _register_models()
@@ -425,9 +426,21 @@ class ServingCell(LifecycleMixin):
         if max_seq_len:
             cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
 
-        n = len(jax.devices())
-        shape = auto_mesh_shape(n)
-        mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
+        # Mesh: an explicit --chips N is the ModelSpec's grant — exactly N
+        # chips, all on the tensor axis, dying loudly (serving_mesh) when
+        # the grant exceeds what this process can see rather than silently
+        # serving on fewer chips. Without the flag (bare/dev boots) the
+        # cell keeps the old behavior: every visible device, factorized by
+        # the auto heuristic.
+        if chips is not None:
+            try:
+                mesh = serving_mesh(chips)
+            except ValueError as e:
+                raise SystemExit(f"--chips {chips}: {e}") from e
+        else:
+            n = len(jax.devices())
+            shape = auto_mesh_shape(n)
+            mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
 
         forward_fn = None
         param_specs = None
@@ -987,6 +1000,16 @@ class ServingCell(LifecycleMixin):
                 "kvPageTokens": self.engine.page_tokens,
                 "fromProfile": self.engine.tune is not None,
             },
+            # Serving mesh: chip count and axis layout this engine's jitted
+            # programs are sharded over (meshChips == 1 means single-chip).
+            # getattr: harness fakes duck-type the engine without a mesh.
+            "mesh": ({
+                "chips": int(self.engine.mesh.size),
+                "shape": {ax: int(sz) for ax, sz
+                          in self.engine.mesh.shape.items() if sz > 1},
+                "kvSharded": bool(
+                    any(self.engine._cache_shardings()[0].spec)),
+            } if getattr(self.engine, "mesh", None) is not None else None),
             # Paged KV pool occupancy (0/0 on the legacy layout): what the
             # operator watches to size kvPageTokens / the pool.
             "kvPages": {
@@ -1023,7 +1046,8 @@ class EmbeddingCell(LifecycleMixin):
 
     def __init__(self, model: str, *, batch_size: int = 16,
                  pooling: str = "cls", checkpoint: str | None = None,
-                 dtype: str | None = None, seed: int = 0):
+                 dtype: str | None = None, seed: int = 0,
+                 chips: int | None = None):
         import dataclasses
 
         import jax
@@ -1031,7 +1055,7 @@ class EmbeddingCell(LifecycleMixin):
         _enable_compilation_cache()
 
         from kukeon_tpu.models import bert
-        from kukeon_tpu.parallel import auto_mesh_shape, make_mesh
+        from kukeon_tpu.parallel import auto_mesh_shape, make_mesh, serving_mesh
         from kukeon_tpu.serving import EmbeddingEngine
 
         _register_models()
@@ -1040,9 +1064,15 @@ class EmbeddingCell(LifecycleMixin):
             import jax.numpy as jnp
 
             cfg = dataclasses.replace(cfg, dtype=getattr(jnp, dtype))
-        n = len(jax.devices())
-        shape = auto_mesh_shape(n)
-        mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
+        if chips is not None:
+            try:
+                mesh = serving_mesh(chips)
+            except ValueError as e:
+                raise SystemExit(f"--chips {chips}: {e}") from e
+        else:
+            n = len(jax.devices())
+            shape = auto_mesh_shape(n)
+            mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
         if checkpoint:
             params = self._load_checkpoint(checkpoint, cfg)
         else:
@@ -1503,6 +1533,10 @@ def main(argv=None) -> int:
     # role keeps the full engine.
     ap.add_argument("--role", choices=("mixed", "prefill", "decode"),
                     default="mixed")
+    # Serving mesh size (ModelSpec chips): exactly N visible chips, all on
+    # the tensor axis. Absent = every visible device, auto-factorized —
+    # the pre-multi-chip behavior.
+    ap.add_argument("--chips", type=int, default=None)
     ap.add_argument("--no-warmup", action="store_true")
     # Admission control: bound the pending queue (shed with 429 past it)
     # and default every request to a deadline (expired requests free their
@@ -1520,7 +1554,8 @@ def main(argv=None) -> int:
     def build():
         if args.model in EMBEDDING_MODELS:
             cell = EmbeddingCell(args.model, batch_size=args.num_slots,
-                                 checkpoint=args.checkpoint, dtype=args.dtype)
+                                 checkpoint=args.checkpoint, dtype=args.dtype,
+                                 chips=args.chips)
             if not args.no_warmup:
                 cell.warmup()
             return cell
@@ -1533,7 +1568,7 @@ def main(argv=None) -> int:
             deadline_s=args.deadline_s or None,
             slo_ttft_p95_ms=args.slo_ttft_p95_ms or None,
             slo_availability=args.slo_availability or None,
-            role=args.role,
+            role=args.role, chips=args.chips,
         )
         # Warmup before the engine thread starts: step() is single-driver.
         if not args.no_warmup:

@@ -275,6 +275,7 @@ class ServingEngine:
         trace_capacity: int = 512,
         kv_page_tokens: int | None = None,
         kv_pool_pages: int | None = None,
+        kv_shard: bool | None = None,
     ):
         # Model pluggability: any forward with llama.forward's signature
         # ((params, cfg, tokens, positions, cache) -> (logits, cache')) and
@@ -313,7 +314,7 @@ class ServingEngine:
         self.tune: "Any | None" = None
         if model_name and (decode_chunk is None or kv_cache_int8 is None
                            or prefill_buckets is None
-                           or kv_page_tokens is None):
+                           or kv_page_tokens is None or kv_shard is None):
             from kukeon_tpu.serving import tuning
 
             self.tune = tuning.load(
@@ -331,6 +332,10 @@ class ServingEngine:
             # legacy contiguous layout, > 0 = paged with that page size.
             if kv_page_tokens is None:
                 kv_page_tokens = self.tune.kv_page_tokens
+            # kv_shard: None = profile (then the divisibility default),
+            # False = replicate the KV cache even on a sharded mesh.
+            if kv_shard is None:
+                kv_shard = self.tune.kv_shard
         decode_chunk = 16 if decode_chunk is None else decode_chunk
         kv_cache_int8 = bool(kv_cache_int8)
         self.model_name = model_name
@@ -369,6 +374,11 @@ class ServingEngine:
             cfg = dataclasses.replace(cfg, int8_pallas=int8_pallas)
         self.cfg = cfg
         self.mesh = mesh
+        # KV-shard lever (autotune sweeps it): None = shard over the mesh's
+        # tensor axis when the KV-head count divides it, False = replicate
+        # the cache (more HBM, no gather in the attention dots), True =
+        # shard — still subject to the divisibility fallback below.
+        self.kv_shard = kv_shard
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len or cfg.max_seq_len
         self.eos_ids = set(eos_ids)
@@ -585,6 +595,10 @@ class ServingEngine:
             "In-flight requests preempted (pages reclaimed, request "
             "requeued ahead of new admissions), by reason.",
             labels=("reason",))
+        reg.gauge("kukeon_engine_mesh_chips",
+                  "Devices in this engine's serving mesh (1 = single-chip; "
+                  "> 1 = tensor-parallel sharded programs and KV pool)."
+                  ).set(mesh.size)
         reg.gauge("kukeon_engine_slots_total",
                   "Decode slots in the fixed batch.").set(num_slots)
         reg.gauge("kukeon_engine_slots_free",
@@ -640,13 +654,31 @@ class ServingEngine:
         """(k/v sharding, scale sharding) for the decode cache."""
         spec = shd.kv_cache_spec()
         tensor_size = self.mesh.shape.get(shd.AXIS_TENSOR, 1)
-        if self.cfg.num_kv_heads % max(tensor_size, 1):
-            # KV heads not divisible by the tensor axis: replicate the cache
-            # (correct, just more HBM) instead of failing device_put.
+        if (self.kv_shard is False
+                or self.cfg.num_kv_heads % max(tensor_size, 1)):
+            # Replicate the cache when the tuner says so or when the KV
+            # heads don't divide the tensor axis (correct, just more HBM)
+            # instead of failing device_put.
             spec = PartitionSpec()
         # Scales [L, B, S, KV] shard like k/v minus the head_dim axis.
         return (NamedSharding(self.mesh, spec),
                 NamedSharding(self.mesh, PartitionSpec(*spec[:4])))
+
+    def _state_shardings(self) -> DecodeState:
+        """NamedSharding mirror of DecodeState — the jitted programs'
+        explicit in/out sharding tree. The KV pool (legacy slots or paged
+        pool alike) lives over the mesh's tensor axis on its kv-head dim;
+        everything host-logical — per-slot lengths, last tokens, active
+        flags — is replicated, because the host block table / slot map is
+        the source of truth and every chip must see all of it."""
+        kv_sh, sc_sh = self._cache_shardings()
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        cache = llama.KVCache(
+            k=kv_sh, v=kv_sh, lengths=repl,
+            k_scale=sc_sh if self.kv_cache_int8 else None,
+            v_scale=sc_sh if self.kv_cache_int8 else None,
+        )
+        return DecodeState(cache=cache, tokens=repl, active=repl)
 
     def _init_state(self) -> DecodeState:
         if self.paged:
@@ -981,22 +1013,56 @@ class ServingEngine:
         # that grew the jit tracing cache is counted + timed by program
         # (prefill covers both the cold and prefix-extend variants). The
         # wrapper forwards .lower/.compile so precompile() is unchanged.
+        #
+        # Every jit names explicit in/out shardings (KUKE014): params by
+        # the model's PartitionSpec tree, KV blocks and the pool over the
+        # mesh's tensor axis (kv-head dim), and everything host-shaped —
+        # tokens, lengths, RNG keys, sampling arrays, block tables —
+        # replicated. On a 1-chip mesh these degenerate to the one device;
+        # on an N-chip mesh they make the layout a statement rather than a
+        # GSPMD inference, so the paged pool is *placed* where
+        # _init_state put it and donation reuses the sharded buffers.
         ct = self.compiles
-        self._prefill = ct.wrap(jax.jit(prefill), "prefill")
-        self._prefill_ext = ct.wrap(jax.jit(prefill_ext), "prefill")
-        self._insert = ct.wrap(jax.jit(insert, donate_argnums=(0,)), "insert")
-        self._decode_chunk = ct.wrap(
-            jax.jit(decode_chunk_fn, static_argnums=(6,), donate_argnums=(1,)),
-            "decode",
-        )
-        self._gather_block = ct.wrap(jax.jit(gather_block), "prefill")
-        self._insert_paged = ct.wrap(
-            jax.jit(insert_paged, donate_argnums=(0,)), "insert")
-        self._decode_chunk_paged = ct.wrap(
-            jax.jit(decode_chunk_paged, static_argnums=(7,),
-                    donate_argnums=(1,)),
-            "decode",
-        )
+        p_sh = self._shardings
+        st_sh = self._state_shardings()
+        kv_sh, sc_sh = self._cache_shardings()
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        self._prefill = ct.wrap(jax.jit(
+            prefill,
+            in_shardings=(p_sh, repl, repl, repl, repl, repl, repl),
+            out_shardings=(repl, kv_sh, kv_sh),
+        ), "prefill")
+        self._prefill_ext = ct.wrap(jax.jit(
+            prefill_ext,
+            in_shardings=(p_sh, kv_sh, kv_sh, repl, repl, repl, repl,
+                          repl, repl, repl),
+            out_shardings=(repl, kv_sh, kv_sh),
+        ), "prefill")
+        self._insert = ct.wrap(jax.jit(
+            insert, donate_argnums=(0,),
+            in_shardings=(st_sh, kv_sh, kv_sh, repl, repl, repl),
+            out_shardings=st_sh,
+        ), "insert")
+        self._decode_chunk = ct.wrap(jax.jit(
+            decode_chunk_fn, static_argnums=(6,), donate_argnums=(1,),
+            in_shardings=(p_sh, st_sh, repl, repl, repl, repl),
+            out_shardings=(st_sh, repl),
+        ), "decode")
+        self._gather_block = ct.wrap(jax.jit(
+            gather_block,
+            in_shardings=(kv_sh, kv_sh, sc_sh, sc_sh, repl),
+            out_shardings=(kv_sh, kv_sh),
+        ), "prefill")
+        self._insert_paged = ct.wrap(jax.jit(
+            insert_paged, donate_argnums=(0,),
+            in_shardings=(st_sh, kv_sh, kv_sh, repl, repl, repl, repl),
+            out_shardings=st_sh,
+        ), "insert")
+        self._decode_chunk_paged = ct.wrap(jax.jit(
+            decode_chunk_paged, static_argnums=(7,), donate_argnums=(1,),
+            in_shardings=(p_sh, st_sh, repl, repl, repl, repl, repl),
+            out_shardings=(st_sh, repl),
+        ), "decode")
 
     def _bucket(self, n: int) -> int:
         return bucket_length(n, self.prefill_buckets)

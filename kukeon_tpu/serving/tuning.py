@@ -40,6 +40,12 @@ class ServingTune:
     # concurrency lever like the others — it must tile max_seq_len and the
     # prefill buckets, which the engine validates at boot.
     kv_page_tokens: int | None = None
+    # Sharding layout (the multi-chip sweep): tensor-axis size of the
+    # winning mesh (None = whatever the cell's chip grant dictates) and
+    # whether the KV pool shards over it (None = the engine's divisibility
+    # default, False = replicate the cache — bigger HBM, no gathers).
+    mesh_tensor: int | None = None
+    kv_shard: bool | None = None
     # Provenance (not consumed by the engine, kept for operators/debugging).
     tok_per_s: float | None = None
     tuned_at: str | None = None
@@ -53,6 +59,10 @@ class ServingTune:
             d["prefill_buckets"] = [int(b) for b in self.prefill_buckets]
         if self.kv_page_tokens:
             d["kv_page_tokens"] = int(self.kv_page_tokens)
+        if self.mesh_tensor:
+            d["mesh_tensor"] = int(self.mesh_tensor)
+        if self.kv_shard is not None:
+            d["kv_shard"] = bool(self.kv_shard)
         if self.tok_per_s is not None:
             d["tok_per_s"] = round(float(self.tok_per_s), 2)
         if self.tuned_at:
@@ -69,6 +79,10 @@ class ServingTune:
                              if buckets else None),
             kv_page_tokens=(int(d["kv_page_tokens"])
                             if d.get("kv_page_tokens") else None),
+            mesh_tensor=(int(d["mesh_tensor"])
+                         if d.get("mesh_tensor") else None),
+            kv_shard=(bool(d["kv_shard"])
+                      if d.get("kv_shard") is not None else None),
             tok_per_s=(float(d["tok_per_s"])
                        if d.get("tok_per_s") is not None else None),
             tuned_at=d.get("tuned_at"),

@@ -17,8 +17,13 @@ import os
 # on a host that has the binaries (the runtime's autodetection would).
 os.environ["KUKEON_NET_ENFORCE"] = "0"
 
+# Appended last so it wins over any caller-provided count. KUKEON_TEST_DEVICES
+# overrides the virtual-chip count (the CI sharded-serving job runs the suite
+# at 4 to prove the multi-chip tests hold on a different factorization).
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count="
+    + os.environ.get("KUKEON_TEST_DEVICES", "8")
 )
 
 import jax  # noqa: E402
@@ -35,6 +40,16 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "faults: tests that arm KUKEON_FAULTS (the fault-injection harness)")
+
+
+@pytest.fixture
+def chips2_mesh():
+    """A 2-chip tensor-parallel serving mesh on the forced CPU devices —
+    the `chips: 2` grant as the engine sees it. Any even virtual-device
+    count satisfies it (8 locally, 4 in the CI sharded job)."""
+    from kukeon_tpu.parallel import serving_mesh
+
+    return serving_mesh(2)
 
 
 @pytest.fixture(autouse=True)

@@ -962,20 +962,21 @@ def _load_bench():
     return mod
 
 
-def test_bench_artifact_v6_and_backcompat(tmp_path):
+def test_bench_artifact_v7_and_backcompat(tmp_path):
     bench = _load_bench()
-    serve = {"backend": "cpu", "n_chips": 1, "model": "tiny",
+    serve = {"backend": "cpu", "n_chips": 2, "model": "tiny",
              "model_id": "tiny", "sessions": 4, "tok_per_s": 100.0,
              "trials": [100.0], "replicas": 3,
              "kv_page_tokens": 16, "max_sessions": 9,
-             "ttft_p95_s": 0.25}
+             "ttft_p95_s": 0.25,
+             "mesh": {"chips": 2, "tensor": 2, "kv_sharded": True}}
     out = tmp_path / "BENCH_rXX.json"
     bench.write_artifact(str(out), serve,
                          {"vs_baseline": 0.5, "handoff_ms_p50": 12.5,
                           "disagg": {"arms": {}},
                           "diurnal": {"peak_p95_s": 0.8, "failed": 0}})
     art = bench.read_artifact(str(out))
-    assert art["schema"] == "kukeon-bench/v6"
+    assert art["schema"] == "kukeon-bench/v7"
     assert art["replicas"] == 3
     assert art["kv_page_tokens"] == 16
     assert art["max_sessions"] == 9
@@ -983,6 +984,7 @@ def test_bench_artifact_v6_and_backcompat(tmp_path):
     assert art["handoff_ms_p50"] == 12.5
     assert art["disagg"] == {"arms": {}}
     assert art["diurnal"] == {"peak_p95_s": 0.8, "failed": 0}
+    assert art["mesh"] == {"chips": 2, "tensor": 2, "kv_sharded": True}
 
     # A v1 point (pre-gateway, single engine) reads back as v5: replicas=1,
     # legacy contiguous KV (kv_page_tokens=0), every session resident, no
@@ -991,7 +993,7 @@ def test_bench_artifact_v6_and_backcompat(tmp_path):
     v1.write_text(json.dumps({"schema": "kukeon-bench/v1", "backend": "cpu",
                               "tok_per_s": 50.0, "sessions": 4}))
     art = bench.read_artifact(str(v1))
-    assert art["schema"] == "kukeon-bench/v6"
+    assert art["schema"] == "kukeon-bench/v7"
     assert art["replicas"] == 1
     assert art["tok_per_s"] == 50.0
     assert art["kv_page_tokens"] == 0
@@ -1000,6 +1002,7 @@ def test_bench_artifact_v6_and_backcompat(tmp_path):
     assert art["handoff_ms_p50"] is None
     assert art["disagg"] is None
     assert art["diurnal"] is None
+    assert art["mesh"] is None
 
     # A v2 point (pre-paged-KV) keeps its replicas and gains the later
     # fields; its TTFT p95 lifts from the latency percentiles it recorded.
@@ -1009,7 +1012,7 @@ def test_bench_artifact_v6_and_backcompat(tmp_path):
                               "replicas": 2,
                               "latency_s": {"ttft": {"p95": 0.4}}}))
     art = bench.read_artifact(str(v2))
-    assert art["schema"] == "kukeon-bench/v6"
+    assert art["schema"] == "kukeon-bench/v7"
     assert art["replicas"] == 2
     assert art["kv_page_tokens"] == 0
     assert art["max_sessions"] == 2
@@ -1022,7 +1025,7 @@ def test_bench_artifact_v6_and_backcompat(tmp_path):
                               "replicas": 1, "kv_page_tokens": 16,
                               "max_sessions": 4}))
     art = bench.read_artifact(str(v3))
-    assert art["schema"] == "kukeon-bench/v6"
+    assert art["schema"] == "kukeon-bench/v7"
     assert art["kv_page_tokens"] == 16
     assert art["max_sessions"] == 4
     assert art["handoff_ms_p50"] is None
@@ -1037,7 +1040,7 @@ def test_bench_artifact_v6_and_backcompat(tmp_path):
                               "handoff_ms_p50": 10.0,
                               "disagg": {"arms": {}}}))
     art = bench.read_artifact(str(v4))
-    assert art["schema"] == "kukeon-bench/v6"
+    assert art["schema"] == "kukeon-bench/v7"
     assert art["ttft_p95_s"] == 0.3
     assert art["handoff_ms_p50"] == 10.0
     assert art["disagg"] == {"arms": {}}
@@ -1053,9 +1056,24 @@ def test_bench_artifact_v6_and_backcompat(tmp_path):
                               "diurnal": {"peak_p95_s": 0.8, "failed": 0},
                               "cold_start": {"p50_s": 30.0}}))
     art = bench.read_artifact(str(v5))
-    assert art["schema"] == "kukeon-bench/v6"
+    assert art["schema"] == "kukeon-bench/v7"
     assert art["diurnal"] == {"peak_p95_s": 0.8, "failed": 0}
     assert art["cold_start"] == {"p50_s": 30.0, "load_s": None}
+    assert art["mesh"] is None
+
+    # A v6 point (pre-multi-chip) gains only the mesh section: explicit
+    # None — single-chip engines had no sharding layout to record.
+    v6 = tmp_path / "BENCH_r10.json"
+    v6.write_text(json.dumps({"schema": "kukeon-bench/v6", "backend": "cpu",
+                              "tok_per_s": 95.0, "sessions": 2,
+                              "replicas": 2, "kv_page_tokens": 16,
+                              "max_sessions": 4, "ttft_p95_s": 0.3,
+                              "cold_start": {"p50_s": 30.0,
+                                             "load_s": {"disk": 1.0}}}))
+    art = bench.read_artifact(str(v6))
+    assert art["schema"] == "kukeon-bench/v7"
+    assert art["mesh"] is None
+    assert art["cold_start"] == {"p50_s": 30.0, "load_s": {"disk": 1.0}}
 
     bad = tmp_path / "BENCH_bad.json"
     bad.write_text(json.dumps({"schema": "nope/v9"}))

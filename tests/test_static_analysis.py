@@ -202,6 +202,67 @@ def test_kuke004_allows_frozen_config(tmp_path):
     assert run_analysis(pkg, select=["KUKE004"]) == []
 
 
+# --- KUKE014: explicit shardings on jitted-program definitions ---------------
+
+
+def test_kuke014_flags_implicit_placement(tmp_path):
+    # ENGINE_HEADER's two jit calls pass neither in_ nor out_shardings:
+    # both programs are findings, keyed by program attribute.
+    pkg = _engine_repo(tmp_path, "")
+    found = run_analysis(pkg, select=["KUKE014"])
+    assert _rules(found) == ["KUKE014", "KUKE014"]
+    assert sorted(f.detail for f in found) == ["_decode_chunk", "_insert"]
+    assert all(f.scope == "ServingEngine._build_programs" for f in found)
+
+
+def test_kuke014_flags_half_specified_jit(tmp_path):
+    pkg = _mini_repo(tmp_path, {"serving/engine.py": '''\
+        import jax
+
+
+        class ServingEngine:
+            def _build_programs(self):
+                def insert(state, kv, length, slot, token):
+                    return state
+
+                self._insert = jax.jit(
+                    insert, donate_argnums=(0,),
+                    in_shardings=(None, None, None, None, None))
+    '''})
+    found = run_analysis(pkg, select=["KUKE014"])
+    assert _rules(found) == ["KUKE014"]
+    assert "out_shardings" in found[0].message
+    assert "in_shardings" not in found[0].message.split(":", 1)[1].split(
+        "out_shardings")[0]
+
+
+def test_kuke014_silent_with_explicit_shardings(tmp_path):
+    # Replication is fine as long as it is spelled: both keywords present
+    # (through the ct.wrap seam, like the real engine) satisfy the rule.
+    pkg = _mini_repo(tmp_path, {"serving/engine.py": '''\
+        import jax
+
+
+        class ServingEngine:
+            def _build_programs(self):
+                def insert(state, kv, length, slot, token):
+                    return state
+
+                def decode_chunk_fn(params, state, key, n_steps):
+                    return state, key
+
+                repl = None
+                self._insert = self.compiles.wrap(jax.jit(
+                    insert, donate_argnums=(0,),
+                    in_shardings=(repl,) * 5, out_shardings=repl), "insert")
+                self._decode_chunk = jax.jit(
+                    decode_chunk_fn, static_argnums=(3,),
+                    in_shardings=(repl, repl, repl),
+                    out_shardings=(repl, repl))
+    '''})
+    assert run_analysis(pkg, select=["KUKE014"]) == []
+
+
 # --- KUKE005: locked-somewhere means locked-everywhere -----------------------
 
 LOCKED_CLASS = '''
@@ -814,7 +875,7 @@ def test_all_rules_are_registered():
     assert registered_rules() == (
         "KUKE001", "KUKE002", "KUKE003", "KUKE004",
         "KUKE005", "KUKE006", "KUKE007", "KUKE008", "KUKE009",
-        "KUKE010", "KUKE011", "KUKE012", "KUKE013",
+        "KUKE010", "KUKE011", "KUKE012", "KUKE013", "KUKE014",
     )
 
 

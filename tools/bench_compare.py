@@ -3,7 +3,7 @@
 previous round and flag regressions.
 
 The bench artifacts (`bench.py --out BENCH_rNN.json`, schema
-kukeon-bench/v1..v6) are the repo's performance trajectory; this tool is
+kukeon-bench/v1..v7) are the repo's performance trajectory; this tool is
 the cheap guard that a round did not silently give back throughput,
 latency, cold start, or HBM headroom:
 
@@ -33,7 +33,8 @@ import re
 import sys
 
 SCHEMAS = ("kukeon-bench/v1", "kukeon-bench/v2", "kukeon-bench/v3",
-           "kukeon-bench/v4", "kukeon-bench/v5", "kukeon-bench/v6")
+           "kukeon-bench/v4", "kukeon-bench/v5", "kukeon-bench/v6",
+           "kukeon-bench/v7")
 
 # (label, path into the artifact, direction: +1 = higher is better)
 METRICS = (
@@ -65,7 +66,7 @@ METRICS = (
 
 def read_artifact(path: str) -> dict | None:
     """A BENCH_rNN.json if it is a bench artifact (any schema version),
-    upgraded to the v6 shape; None for the early raw-transcript rounds."""
+    upgraded to the v7 shape; None for the early raw-transcript rounds."""
     try:
         with open(path) as f:
             artifact = json.load(f)
@@ -73,7 +74,7 @@ def read_artifact(path: str) -> dict | None:
         return None
     if not isinstance(artifact, dict) or artifact.get("schema") not in SCHEMAS:
         return None
-    if artifact["schema"] != "kukeon-bench/v6":
+    if artifact["schema"] != "kukeon-bench/v7":
         artifact = dict(artifact)
         artifact.setdefault("replicas", 1)
         artifact.setdefault("kv_page_tokens", 0)
@@ -86,7 +87,8 @@ def read_artifact(path: str) -> dict | None:
         if isinstance(artifact.get("cold_start"), dict):
             artifact["cold_start"] = dict(artifact["cold_start"])
             artifact["cold_start"].setdefault("load_s", None)
-        artifact["schema"] = "kukeon-bench/v6"
+        artifact.setdefault("mesh", None)
+        artifact["schema"] = "kukeon-bench/v7"
     return artifact
 
 
