@@ -229,6 +229,11 @@ def phase_serve(args) -> None:
         ]
     sp = SamplingParams(max_new_tokens=new_tokens)
 
+    # AOT precompile first: it feeds ProgramTimers the static
+    # cost-analysis FLOPs/bytes (the denominators behind the per-program
+    # MFU / membw gauges and the artifact's program_costs section) and
+    # pre-warms the compile cache the warmup dispatch then hits.
+    engine.precompile((prompt_len,))
     engine.warmup(prompt_len, sp)
     # Warmup's single pass overlaps the tail of the async param transfer;
     # measuring before every byte lands would charge transfer time to
@@ -256,6 +261,15 @@ def phase_serve(args) -> None:
     # obs instruments; peak is None on backends without memory stats (CPU).
     compiles = {p: engine.compiles.count(p)
                 for p in ("prefill", "insert", "decode")}
+    # Roofline ride-along (v8): per-program dispatch counts, settled wall
+    # time, token totals, and the static FLOPs/bytes precompile captured,
+    # plus the headline MFU (the busiest program's model-FLOPs
+    # utilization). All read from the engine's own ProgramTimers — the
+    # same numbers /metrics exposes as kukeon_program_* gauges.
+    engine.timers.settle()
+    program_costs = engine.timers.snapshot()
+    mfu = max((c.get("mfu") or 0.0) for c in program_costs.values()) \
+        if program_costs else 0.0
     peak_hbm = None
     for d in jax.devices():
         try:
@@ -274,6 +288,10 @@ def phase_serve(args) -> None:
         "trials": [round(r, 1) for r in rates],
         "latency_s": latency_percentiles(lat_base),
         "compiles": compiles,
+        "program_costs": program_costs,
+        # Six digits, matching timers.snapshot(): a CPU-smoke MFU is
+        # O(1e-5) and a 4-digit round would flatten it to a lying zero.
+        "mfu": round(mfu, 6),
         "peak_hbm_bytes": peak_hbm,
         "kv_page_tokens": engine.page_tokens,
         # The mesh this measurement ran on: chips, the tensor-axis size,
@@ -1133,6 +1151,63 @@ def phase_autotune(args) -> None:
     print(json.dumps(line))
 
 
+def phase_profile_layers(args) -> None:
+    """Per-layer cost profiling (obs/profile.profile_layers): lower every
+    transformer component (embed, each layer, head) individually at the
+    prefill and decode shapes, record XLA cost-analysis FLOPs/bytes plus
+    measured wall time, and persist the profile next to the serving tune
+    keyed ``model|backend|n_chips`` — `kuke profile layers` renders it;
+    the pipeline-split planner (ROADMAP item 2) consumes it. An armed
+    ``profile.layers`` fault degrades to recorded per-component error
+    entries and skips persistence — a clean reported failure, never a
+    crashed bench."""
+    sys.path.insert(0, REPO)
+    import jax
+
+    from kukeon_tpu.models import checkpoints, llama
+    from kukeon_tpu.obs import profile as obs_profile
+    from kukeon_tpu.parallel import auto_mesh_shape, make_mesh
+    from kukeon_tpu.serving import tuning
+
+    backend = jax.default_backend()
+    n_chips = len(jax.devices())
+    if args.checkpoint:
+        params, cfg = checkpoints.load_quantized(args.checkpoint)
+        model_id = "llama3-8b"
+        prefill_len, decode_batch = 128, 4
+    else:
+        cfg = llama.llama_tiny()
+        params = llama.init_params(jax.random.key(0), cfg)
+        model_id = "tiny"
+        prefill_len, decode_batch = 32, 2
+    shape = auto_mesh_shape(n_chips)
+    mesh = make_mesh(data=shape["data"], tensor=shape["tensor"])
+    _log(f"profile-layers: {model_id} [{backend}] "
+         f"prefill_len={prefill_len} decode_batch={decode_batch}")
+    prof = obs_profile.profile_layers(
+        params, cfg, mesh, prefill_len=prefill_len,
+        decode_batch=decode_batch)
+    key = tuning.profile_key(model_id, backend, n_chips)
+    prof["key"] = key
+    line = {"metric": f"per-layer cost profile, {model_id},"
+                      f" {n_chips} chip(s) [{backend}]",
+            "key": key,
+            "num_layers": prof.get("num_layers"),
+            "model_flops": prof.get("model_flops"),
+            "model_bytes": prof.get("model_bytes"),
+            "errors": prof.get("errors", 0)}
+    if prof.get("errors"):
+        line["failed"] = [c.get("name") for c in prof.get("components", ())
+                          if c.get("error")]
+        _log(f"profile-layers: {prof['errors']} component(s) failed; "
+             "profile not persisted")
+    else:
+        line["path"] = tuning.save_layer_profile(
+            model_id, backend, n_chips, prof)
+        _log(f"profile-layers: persisted -> {line['path']}")
+    print(json.dumps(line), flush=True)
+
+
 # --- cold-start phase ---------------------------------------------------------
 
 def _tail_file(path: str, limit: int = 2500) -> str:
@@ -1347,7 +1422,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", default="all",
                     choices=["all", "serve", "embed", "ab", "autotune",
-                             "gateway", "mixed", "disagg", "diurnal"])
+                             "gateway", "mixed", "disagg", "diurnal",
+                             "profile-layers"])
     # Diurnal ramp through the gateway + spillover (phase_diurnal): the
     # night->peak->trough arrival schedule with a deliberately
     # under-provisioned fleet; the headline numbers are zero client-visible
@@ -1367,6 +1443,10 @@ def main() -> None:
     # Sweep the serving perf levers and persist the winner to the tune
     # profile that ServingEngine/ServingCell read at boot (phase_autotune).
     ap.add_argument("--autotune", action="store_true")
+    # Per-layer cost profiling (phase_profile_layers): lower each model
+    # component individually, record cost-analysis FLOPs/bytes + wall
+    # time, persist next to the serving tune for `kuke profile layers`.
+    ap.add_argument("--profile-layers", action="store_true")
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--decode-chunk", type=int,
                     default=int(os.environ.get("KUKEON_BENCH_CHUNK", "16")))
@@ -1395,8 +1475,8 @@ def main() -> None:
     ap.add_argument("--cold-runs", type=int, default=None,
                     help="override the number of cold-start runs")
     # Standardized trajectory artifact (e.g. --out BENCH_r06.json): one
-    # schema-versioned JSON file per run (kukeon-bench/v7; read_artifact
-    # upgrades v1-v6 points) with percentiles, throughput, compile counts,
+    # schema-versioned JSON file per run (kukeon-bench/v8; read_artifact
+    # upgrades v1-v7 points) with percentiles, throughput, compile counts,
     # peak HBM, replica count, and the disaggregation + diurnal sections,
     # so BENCH_*.json points stay comparable across rounds regardless of
     # how the console line evolves.
@@ -1405,6 +1485,9 @@ def main() -> None:
 
     if args.autotune or args.phase == "autotune":
         phase_autotune(args)
+        return
+    if args.profile_layers or args.phase == "profile-layers":
+        phase_profile_layers(args)
         return
     if args.disagg or args.phase == "disagg":
         phase_disagg(args)
@@ -1590,17 +1673,19 @@ def read_artifact(path: str) -> dict:
     (pre-streamed-boot) gain ``cold_start.load_s: None`` (no disk / cast /
     upload sub-phase ledger existed before the streamed checkpoint
     pipeline); v1–v6 points (pre-multi-chip) gain ``mesh: None`` (the
-    measurement ran before the sharded serving mesh existed — a v7 point
-    always records its mesh layout, single-chip included)."""
+    measurement ran before the sharded serving mesh existed); v1–v7
+    points (pre-roofline) gain ``program_costs: None`` and ``mfu: None``
+    (no per-program timer/cost instrumentation existed — a v8 point
+    always records both when the serve phase ran)."""
     with open(path) as f:
         artifact = json.load(f)
     schema = artifact.get("schema")
     if schema not in ("kukeon-bench/v1", "kukeon-bench/v2",
                       "kukeon-bench/v3", "kukeon-bench/v4",
                       "kukeon-bench/v5", "kukeon-bench/v6",
-                      "kukeon-bench/v7"):
+                      "kukeon-bench/v7", "kukeon-bench/v8"):
         raise ValueError(f"unknown bench artifact schema {schema!r} in {path}")
-    if schema != "kukeon-bench/v7":
+    if schema != "kukeon-bench/v8":
         artifact = dict(artifact)
         artifact.setdefault("replicas", 1)              # v1 -> v2
         artifact.setdefault("kv_page_tokens", 0)        # v2 -> v3
@@ -1614,7 +1699,9 @@ def read_artifact(path: str) -> dict:
             artifact["cold_start"] = dict(artifact["cold_start"])
             artifact["cold_start"].setdefault("load_s", None)
         artifact.setdefault("mesh", None)               # v6 -> v7
-        artifact["schema"] = "kukeon-bench/v7"
+        artifact.setdefault("program_costs", None)      # v7 -> v8
+        artifact.setdefault("mfu", None)
+        artifact["schema"] = "kukeon-bench/v8"
     return artifact
 
 
@@ -1622,7 +1709,7 @@ def write_artifact(path: str, serve: dict, result: dict) -> None:
     """The standardized BENCH_rNN.json trajectory point: fixed schema, one
     file per run, every field from the product's own instruments."""
     artifact = {
-        "schema": "kukeon-bench/v7",
+        "schema": "kukeon-bench/v8",
         "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "backend": serve["backend"],
         "n_chips": serve["n_chips"],
@@ -1665,6 +1752,12 @@ def write_artifact(path: str, serve: dict, result: dict) -> None:
         # tensor-axis size, whether the KV pool sharded); None only for
         # phases that never built an engine (e.g. --cold-start-only).
         "mesh": serve.get("mesh"),
+        # v8: the roofline section — per-program dispatch/wall/token
+        # counters with their static cost-analysis FLOPs/bytes (the
+        # ProgramTimers snapshot) and the headline MFU; None for phases
+        # that never ran the serve loop.
+        "program_costs": serve.get("program_costs"),
+        "mfu": serve.get("mfu"),
     }
     # v6: cold_start carries the streamed-load sub-phase ledger (disk /
     # cast / upload medians); explicit None when the boot exported none.

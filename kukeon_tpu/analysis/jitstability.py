@@ -1,5 +1,5 @@
-"""KUKE003/KUKE004/KUKE014 — jit-stability and placement of the engine's
-compiled programs.
+"""KUKE003/KUKE004/KUKE014/KUKE015 — jit-stability, placement, and
+observability of the engine's compiled programs.
 
 The engine's performance story rests on "decode never recompiles": its
 jitted programs are built once in ``_build_programs`` and every dispatch
@@ -31,6 +31,17 @@ A third statically-checkable property guards the multi-chip story:
   in ``_build_programs`` must pass BOTH keywords — replication is fine,
   but it must be spelled (``NamedSharding(mesh, PartitionSpec())``), never
   defaulted.
+
+A fourth guards the roofline instrumentation:
+
+- **KUKE015 — programs must register with the program-timer seam.** Every
+  jitted program wrapped in ``_build_programs`` must pass a ``timer=``
+  keyword to ``CompileTracker.wrap`` (``timer=tm.track("<program>")``).
+  A program wrapped without one dispatches invisibly to the per-program
+  wall-time/MFU gauges (``kukeon_program_seconds``,
+  ``kukeon_program_mfu``) — the flight recorder and the bench's
+  ``program_costs`` section would silently under-report where device
+  time goes.
 
 All rules are scoped to ``serving/engine.py``'s ``ServingEngine``: the
 pass reads ``_build_programs`` to learn which inner functions are jitted
@@ -226,6 +237,56 @@ def check_jit_shardings(sources: Sequence[SourceFile],
                         f"replication or decode-path resharding) — spell "
                         f"the sharding, using NamedSharding(mesh, "
                         f"PartitionSpec()) for intentional replication",
+                        scope=f"{cls.name}._build_programs",
+                        detail=target.attr))
+    return findings
+
+
+def _find_wrap_call(node: ast.AST) -> ast.Call | None:
+    """The ``<tracker>.wrap(...)`` call inside an expression like
+    ``ct.wrap(jax.jit(fn), "name", timer=...)``."""
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "wrap"):
+            return sub
+    return None
+
+
+@register_pass(("KUKE015",))
+def check_program_timers(sources: Sequence[SourceFile],
+                         package_root: str) -> list[Finding]:
+    """Every jitted program must register with the program-timer seam."""
+    findings: list[Finding] = []
+    for src in sources:
+        if not src.rel.endswith(ENGINE_FILE_SUFFIX):
+            continue
+        for cls in src.tree.body:
+            if not (isinstance(cls, ast.ClassDef)
+                    and cls.name == ENGINE_CLASS):
+                continue
+            build = next(
+                (m for m in cls.body if isinstance(m, ast.FunctionDef)
+                 and m.name == "_build_programs"), None)
+            if build is None:
+                continue
+            for node in ast.walk(build):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                target = node.targets[0]
+                if not (is_self_attr(target)
+                        and target.attr in JITTED_PROGRAMS):
+                    continue
+                wrap_call = _find_wrap_call(node.value)
+                if wrap_call is None or not any(
+                        kw.arg == "timer" for kw in wrap_call.keywords):
+                    findings.append(Finding(
+                        "KUKE015", src.rel, node.lineno,
+                        f"jitted program {target.attr} is built without a "
+                        f"timer= registration on its CompileTracker.wrap: "
+                        f"its dispatches are invisible to the per-program "
+                        f"wall-time/MFU gauges and the flight recorder — "
+                        f"wrap it with timer=tm.track(\"<program>\")",
                         scope=f"{cls.name}._build_programs",
                         detail=target.attr))
     return findings

@@ -64,6 +64,7 @@ POINTS = (
     "checkpoint.stream",
     "devices.probe_wedged",
     "profile.capture",
+    "profile.layers",
 )
 
 

@@ -41,6 +41,15 @@ from kukeon_tpu.obs.device import (  # noqa: F401
     ProfileSpool,
     device_memory_collector,
 )
+from kukeon_tpu.obs.profile import (  # noqa: F401
+    LAYER_PROFILE_SCHEMA,
+    PROGRAMS,
+    FlightRecorder,
+    ProgramTimers,
+    cost_summary,
+    device_peaks,
+    profile_layers,
+)
 from kukeon_tpu.obs.slo import SloObjectives, SloTracker  # noqa: F401
 from kukeon_tpu.obs.tsdb import (  # noqa: F401
     AGGS,

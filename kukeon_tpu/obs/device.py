@@ -85,13 +85,19 @@ class _TrackedJit:
 
     Attribute access (``.lower``, ``.compile``) forwards to the underlying
     jit function so AOT precompilation paths keep working unchanged.
+
+    ``timer`` (an ``obs.profile._ProgramTimer``) additionally marks every
+    dispatch for deferred roofline timing: the mark is settled later
+    inside the engine's counted ``_fetch`` seam, so timing adds zero
+    blocking work here — dispatch stays async.
     """
 
-    def __init__(self, fn, program: str, counter, seconds):
+    def __init__(self, fn, program: str, counter, seconds, timer=None):
         self._fn = fn
         self._program = program
         self._m_compiles = counter
         self._m_seconds = seconds
+        self._timer = timer
 
     def __call__(self, *args, **kwargs):
         before = _cache_size(self._fn)
@@ -103,6 +109,8 @@ class _TrackedJit:
                 self._m_compiles.inc(after - before, program=self._program)
                 self._m_seconds.observe(time.monotonic() - t0,
                                         program=self._program)
+        if self._timer is not None:
+            self._timer.dispatched(t0, out)
         return out
 
     def __getattr__(self, name):
@@ -130,8 +138,12 @@ class CompileTracker:
             "Wall time of dispatches that compiled, by program.",
             labels=("program",))
 
-    def wrap(self, fn, program: str) -> _TrackedJit:
-        return _TrackedJit(fn, program, self._m_compiles, self._m_seconds)
+    def wrap(self, fn, program: str, timer=None) -> _TrackedJit:
+        """Wrap one jitted program. ``timer`` registers the program with
+        the roofline seam (obs/profile.ProgramTimers.track) — kukelint
+        KUKE015 requires every engine program to pass one."""
+        return _TrackedJit(fn, program, self._m_compiles, self._m_seconds,
+                           timer=timer)
 
     def count(self, program: str) -> int:
         return int(self._m_compiles.value(program=program))

@@ -145,6 +145,23 @@ def merge(parts: list[dict[str, Family]]) -> dict[str, Family]:
     return merged
 
 
+def scrape_age_family(ages: dict[str, float]) -> Family:
+    """The ``kukeon_cell_scrape_age_seconds{cell=}`` staleness family:
+    seconds since each cell's last GOOD scrape. A failing cell's age
+    keeps growing while ``kukeon_cell_scrape_ok`` sits at 0 — the two
+    together distinguish "stale but last known good" from "never seen".
+    Cells with no good scrape yet contribute no sample."""
+    fam = Family(
+        "kukeon_cell_scrape_age_seconds", "gauge",
+        "Seconds since the last successful scrape of each cell "
+        "(grows while a cell is down; kuke top dims rows past 2 "
+        "scrape intervals).")
+    for cell, age in sorted(ages.items()):
+        fam.samples.append(("kukeon_cell_scrape_age_seconds",
+                            {"cell": str(cell)}, f"{max(0.0, age):.3f}"))
+    return fam
+
+
 def histogram_counts(fam: Family, **match: str
                      ) -> tuple[tuple[float, ...], list[int]]:
     """(finite bucket bounds, per-bucket counts + overflow slot) recovered

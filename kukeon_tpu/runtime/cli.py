@@ -662,15 +662,27 @@ def render_top(rows, sparks=None) -> str:
 
     if not rows:
         return "no running model cells"
+    # Staleness dimming: a row whose last GOOD scrape (ScrapeCells'
+    # scrapeAgeS, from the daemon's kukeon_cell_scrape_age_seconds
+    # bookkeeping) is older than 2 scrape intervals renders ANSI-dim —
+    # its numbers are last-known-good, not current. Env name mirrors
+    # daemon.SCRAPE_INTERVAL_ENV (not imported: the daemon module drags
+    # in the whole controller stack).
+    stale_after_s = 2 * float(
+        os.environ.get("KUKEON_SCRAPE_INTERVAL_S", "") or 10.0)
     lines = []
     fmt = "{:<32} {:<8} {:<6} {:>7} {:>8} {:>8} {:>6} {:>14} {:>9}"
     lines.append(fmt.format("CELL", "MODEL", "READY", "QPS", "P50TTFT",
                             "P95TTFT", "QUEUE", "HBM", "RESTARTS"))
     for r in rows:
+        if (r.get("scrapeAgeS") or 0.0) > stale_after_s:
+            add = lambda ln: lines.append(f"\x1b[2m{ln}\x1b[0m")  # noqa: E731
+        else:
+            add = lines.append
         if not r.get("ok"):
-            lines.append(fmt.format(r["cell"], "-", "down", "-", "-", "-",
-                                    "-", "-", r.get("restarts", 0))
-                         + f"  ({r.get('error', 'scrape failed')})")
+            add(fmt.format(r["cell"], "-", "down", "-", "-", "-",
+                           "-", "-", r.get("restarts", 0))
+                + f"  ({r.get('error', 'scrape failed')})")
             continue
         if r.get("kind") == "gateway":
             # Gateway row: the replicated cell's front door. READY is the
@@ -692,7 +704,7 @@ def render_top(rows, sparks=None) -> str:
                              if r.get("handoffMsP50") is not None else "")
                           + (f" fallbacks={r['handoffFallbacks']}"
                              if r.get("handoffFallbacks") else ""))
-            lines.append(fmt.format(
+            add(fmt.format(
                 r["cell"], r.get("model") or "-", ready,
                 f"{r['qps']:.1f}" if r.get("qps") is not None else "-",
                 "-", "-", "-", "-", r.get("restarts", 0))
@@ -706,7 +718,7 @@ def render_top(rows, sparks=None) -> str:
         # directly to a reconstructable trace (`kuke trace <id>`).
         exemplar = (f"  (p95 trace={r['ttftP95TraceId']})"
                     if r.get("ttftP95TraceId") else "")
-        lines.append(fmt.format(
+        add(fmt.format(
             r["cell"], r.get("model") or "-",
             "yes" if r.get("ready") else "no",
             f"{r['qps']:.1f}" if r.get("qps") is not None else "-",
@@ -719,13 +731,13 @@ def render_top(rows, sparks=None) -> str:
             # near its limit OOMs the whole mesh, so show each one with
             # its high-water mark.
             for dev, h in r["hbmPerDevice"].items():
-                lines.append(
+                add(
                     f"  chip {dev}: hbm {_fmt_bytes(h.get('inUse'))}"
                     f"/{_fmt_bytes(h.get('limit'))}"
                     f" peak {_fmt_bytes(h.get('peak'))}")
         sp = (sparks or {}).get(r["cell"])
         if sp:
-            lines.append("  {:<30} qps {:<12} p95 {:<12} queue {:<12}".format(
+            add("  {:<30} qps {:<12} p95 {:<12} queue {:<12}".format(
                 "history:", sparkline(sp.get("qps", ()), 10),
                 sparkline(sp.get("p95", ()), 10),
                 sparkline(sp.get("queue", ()), 10)).rstrip())
@@ -990,6 +1002,134 @@ def cmd_trace(args):
         return 0
     print(render_trace(args.trace_id, spans))
     return 0 if spans else 1
+
+
+def render_timeline(steps: list[dict]) -> str:
+    """The engine-step flight recorder as a table: one line per recorded
+    engine-loop step — wall time, batch occupancy, decode chunk size,
+    tokens emitted, host transfers, preemptions, the per-program wall
+    split, and the trace ids seated that step (each resolvable via
+    `kuke trace <id>`). Pure so tests drive it without a daemon."""
+    if not steps:
+        return ("no recorded engine steps "
+                "(cell idle, or no flight recorder)")
+    base = min(s.get("t") or 0.0 for s in steps)
+    fmt = "{:>9} {:>5} {:>9} {:>5} {:>5} {:>6} {:>5} {:>4} {:>5}"
+    lines = [fmt.format("+T", "SEQ", "WALL", "OCC", "CHUNK", "TOKENS",
+                        "XFER", "PRE", "QUEUE") + "  DETAIL"]
+    for s in sorted(steps, key=lambda x: (x.get("t") or 0.0,
+                                          x.get("seq") or 0)):
+        occ = (f"{s.get('occupancy', 0)}/{s['slots']}" if s.get("slots")
+               else str(s.get("occupancy", 0)))
+        xfer = (s.get("fetches") or 0) + (s.get("uploads") or 0)
+        progs = " ".join(
+            f"{k} {v * 1000:.1f}ms"
+            for k, v in sorted((s.get("programs") or {}).items()))
+        traces = ",".join(s.get("traces") or ())
+        detail = "  ".join(b for b in (
+            progs,
+            f"traces={traces}" if traces else "",
+            f"[{s['cell']}]" if s.get("cell") else "") if b)
+        lines.append(fmt.format(
+            f"+{(s.get('t') or base) - base:.3f}s",
+            s.get("seq", "-"),
+            f"{(s.get('wall_s') or 0) * 1000:.1f}ms",
+            occ, s.get("chunk_k", "-"), s.get("tokens", 0),
+            xfer, s.get("preemptions", 0), s.get("queue_depth", "-"))
+            + (f"  {detail}" if detail else ""))
+    return "\n".join(lines)
+
+
+def cmd_timeline(args):
+    """The flight-recorder view: the daemon unions the matching cells'
+    /v1/timeline rings (Timeline RPC) and this renders the last N
+    engine-loop steps — what the batch looked like, where the step's
+    wall time went per program, and which traces were seated, so a
+    latency spike localizes to a step before `kuke trace` zooms in."""
+    try:
+        out = _client(args).call("Timeline", cell=args.cell, n=args.n)
+    except KukeonError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    steps = out.get("steps", [])
+    if args.json:
+        _print(steps, True)
+        return 0
+    print(render_timeline(steps))
+    return 0 if steps else 1
+
+
+def _fmt_count(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(n) < 1000 or unit == "P":
+            return f"{n:.1f}{unit}" if unit else f"{n:.0f}"
+        n /= 1000.0
+    return f"{n:.1f}P"
+
+
+def render_layer_profile(key: str, prof: dict) -> str:
+    """One persisted per-layer cost profile (obs/profile.profile_layers,
+    written by `bench.py --profile-layers`) as a table: per component and
+    shape, the XLA cost-analysis FLOPs/bytes and measured wall time,
+    with the whole-model totals as the roofline reference. Pure; reads
+    no accelerator state."""
+    head = [f"{key}  ({prof.get('schema', '?')}"
+            + (f", profiled {prof['profiled_at']}"
+               if prof.get("profiled_at") else "") + ")"]
+    head.append(
+        f"  layers={prof.get('num_layers', '?')}"
+        f" prefill_len={prof.get('prefill_len', '?')}"
+        f" decode_batch={prof.get('decode_batch', '?')}"
+        f" model_flops={_fmt_count(prof.get('model_flops'))}"
+        f" model_bytes={_fmt_bytes(prof.get('model_bytes'))}")
+    if prof.get("errors"):
+        head.append(f"  {prof['errors']} component(s) failed to profile")
+    fmt = "  {:<10} {:<9} {:>10} {:>10} {:>10}"
+    lines = head + [fmt.format("COMPONENT", "SHAPE", "FLOPS", "BYTES",
+                               "WALL")]
+    for comp in prof.get("components", []):
+        name = comp.get("name", "?")
+        if comp.get("error"):
+            lines.append(fmt.format(name, "-", "-", "-", "-")
+                         + f"  ({comp['error']})")
+            continue
+        for shape in ("prefill", "decode"):
+            rec = comp.get(shape)
+            if not isinstance(rec, dict):
+                continue
+            wall = (f"{rec['wall_s'] * 1000:.2f}ms"
+                    if rec.get("wall_s") is not None else "-")
+            lines.append(fmt.format(
+                name, shape, _fmt_count(rec.get("flops")),
+                _fmt_bytes(rec.get("bytes")), wall))
+    return "\n".join(lines)
+
+
+def cmd_profile(args):
+    """Render persisted per-layer cost profiles. Reads the local profile
+    file (serving/tuning.py, next to the serving tune) only — no daemon,
+    no accelerator runtime — so it works anywhere the bench ran
+    `--profile-layers`. An optional key substring narrows the listing
+    (keys are ``model|backend|n_chips``)."""
+    from kukeon_tpu.serving import tuning
+
+    profs = tuning.load_layer_profiles()
+    if args.key:
+        profs = {k: v for k, v in profs.items() if args.key in k}
+    if args.json:
+        _print(profs, True)
+        return 0
+    if not profs:
+        print("no persisted layer profiles"
+              + (f" matching {args.key!r}" if args.key else "")
+              + f" in {tuning.layer_profile_path()}"
+              " (run bench.py --profile-layers)")
+        return 1
+    print("\n\n".join(render_layer_profile(k, v)
+                      for k, v in sorted(profs.items())))
+    return 0
 
 
 def cmd_scale(args):
@@ -1380,6 +1520,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="32-hex trace id (from logs, /v1/trace, or the "
                          "TTFT exemplar in `kuke top`)")
 
+    sp = sub_add("timeline")
+    sp.add_argument("cell", nargs="?", default=None,
+                    help="cell key substring (realm/space/stack/name); "
+                         "omit for the whole fleet")
+    sp.add_argument("-n", type=int, default=50, dest="n",
+                    help="newest engine steps to fetch per cell")
+
+    sp = sub_add("profile")
+    sp.add_argument("profile_cmd", choices=["layers"])
+    sp.add_argument("key", nargs="?", default=None,
+                    help="profile key substring (keys are "
+                         "model|backend|n_chips)")
+
     sp = sub_add("rollout")
     sp.add_argument("name")
     sp.add_argument("--drain-timeout", type=float, default=60.0,
@@ -1463,6 +1616,8 @@ HANDLERS = {
     "alerts": cmd_alerts,
     "scale": cmd_scale,
     "trace": cmd_trace,
+    "timeline": cmd_timeline,
+    "profile": cmd_profile,
     "rollout": cmd_rollout,
     "doctor": cmd_doctor,
     "refresh": cmd_refresh,

@@ -184,6 +184,51 @@ def fetch_traces(endpoints: list[tuple[str, str, dict]],
     return out
 
 
+def fetch_timelines(endpoints: list[tuple[str, str, dict]],
+                    n: int = 50,
+                    timeout_s: float | None = None) -> list[dict]:
+    """Union every cell's ``/v1/timeline`` flight-recorder ring (gateway
+    included) into one engine-step list, each step tagged with its cell
+    key. Same degradation contract as :func:`fetch_traces`: concurrent,
+    per-cell timeout, never raises — a cell without a recorder or an
+    unreachable one contributes nothing.
+
+    Steps come back sorted by wall-clock stamp (oldest first) so
+    `kuke timeline` can lay the fleet-wide step sequence without
+    re-sorting."""
+    import urllib.request
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get(SCRAPE_TIMEOUT_ENV, "") or
+                          DEFAULT_SCRAPE_TIMEOUT_S)
+    results: list[list[dict]] = [[] for _ in endpoints]
+
+    def work(i: int, key: str, url: str) -> None:
+        try:
+            with urllib.request.urlopen(
+                    url + f"/v1/timeline?n={int(n)}",
+                    timeout=timeout_s) as r:
+                steps = json.loads(r.read()).get("steps", [])
+        except Exception:  # noqa: BLE001 — a dead/recorderless cell contributes nothing
+            return
+        for s in steps:
+            if isinstance(s, dict):
+                s["cell"] = key
+                results[i].append(s)
+
+    threads = [threading.Thread(target=work, args=(i, key, url),
+                                daemon=True, name=f"timeline-{key}")
+               for i, (key, url, _rec) in enumerate(endpoints)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + timeout_s * 2 + 1.0
+    for t in threads:
+        t.join(timeout=max(0.1, deadline - time.monotonic()))
+    out = [s for part in results for s in part]
+    out.sort(key=lambda s: s.get("t") or 0.0)
+    return out
+
+
 def _scrape_ok_family(scrapes: list[dict]) -> "fed.Family":
     """The per-cell scrape verdict as a synthetic family — both the
     federated Metrics exposition and the telemetry loop's TSDB ingest
@@ -254,6 +299,12 @@ class FleetTelemetry:
         # Only the telemetry tick mutates this (one loop thread); reads
         # happen through the gauge snapshot.
         self._consec_fail: dict[str, int] = {}
+        # Last wall-clock time each cell's /metrics scrape SUCCEEDED.
+        # Shared between the telemetry loop and the on-demand
+        # Metrics/ScrapeCells RPCs (connection threads), hence the lock;
+        # feeds kukeon_cell_scrape_age_seconds and `kuke top` dimming.
+        self._ages_lock = sanitize.lock("FleetTelemetry._ages_lock")
+        self._last_good: dict[str, float] = {}   # guarded-by: _ages_lock
         self._reg.gauge(
             "kukeon_tsdb_series",
             "Time series currently resident in the in-daemon store."
@@ -267,6 +318,33 @@ class FleetTelemetry:
             "New series refused because the store hit "
             "KUKEON_TSDB_MAX_SERIES."
         ).set_function(lambda: self.tsdb.stats()["droppedSeries"])
+
+    def note_scrapes(self, scrapes: list[dict],
+                     at: float | None = None) -> dict[str, float]:
+        """Record the last-good wall time per cell from any federated pass
+        (the telemetry tick or an on-demand Metrics/ScrapeCells RPC),
+        forget cells that left the fleet, and return the current
+        {cell: seconds since last good scrape} map."""
+        now = self._clock() if at is None else at
+        seen = {s["cell"] for s in scrapes}
+        with self._ages_lock:
+            for s in scrapes:
+                if s["ok"]:
+                    self._last_good[s["cell"]] = now
+            for cell in [c for c in self._last_good if c not in seen]:
+                # Departed cell: a frozen age sample would read as "stale
+                # cell" forever in `kuke top` — drop it with the cell.
+                del self._last_good[cell]
+            return {c: max(0.0, now - t)
+                    for c, t in self._last_good.items()}
+
+    def scrape_ages(self, at: float | None = None) -> dict[str, float]:
+        """{cell: seconds since its last GOOD scrape}, cells never seen
+        good absent (kukeon_cell_scrape_ok 0 marks those)."""
+        now = self._clock() if at is None else at
+        with self._ages_lock:
+            return {c: max(0.0, now - t)
+                    for c, t in self._last_good.items()}
 
     def tick(self, at: float | None = None) -> list[dict]:
         """One telemetry pass; returns the alert transitions it caused."""
@@ -293,6 +371,10 @@ class FleetTelemetry:
                 fed.inject_label(s["families"], cell=s["cell"])
                 parts.append(s["families"])
         parts.append({"kukeon_cell_scrape_ok": _scrape_ok_family(scrapes)})
+        ages = self.note_scrapes(scrapes, at=now)
+        if ages:
+            parts.append({"kukeon_cell_scrape_age_seconds":
+                          fed.scrape_age_family(ages)})
         for p in parts:
             self.tsdb.ingest(p, at=now)
         self._m_ticks.inc()
@@ -770,6 +852,7 @@ class RPCService:
         scrapes = scrape_fleet(self.ctl)
         if not scrapes:
             return {"contentType": expo.CONTENT_TYPE, "text": own_text}
+        ages = self.telemetry.note_scrapes(scrapes)
         parts = [fed.parse(own_text)]
         for s in scrapes:
             if s["ok"]:
@@ -777,6 +860,9 @@ class RPCService:
                 parts.append(s["families"])
         merged = fed.merge(parts)
         merged["kukeon_cell_scrape_ok"] = _scrape_ok_family(scrapes)
+        if ages:
+            merged["kukeon_cell_scrape_age_seconds"] = (
+                fed.scrape_age_family(ages))
         return {"contentType": expo.CONTENT_TYPE,
                 "text": fed.render(merged)}
 
@@ -787,7 +873,9 @@ class RPCService:
         /metrics plus the daemon's records, never a second bookkeeping
         path."""
         rows = []
-        for s in scrape_fleet(self.ctl, timeoutS):
+        scrapes = scrape_fleet(self.ctl, timeoutS)
+        ages = self.telemetry.note_scrapes(scrapes)
+        for s in scrapes:
             rec = s["record"]
             row = {"cell": s["cell"], "url": s["url"], "ok": s["ok"],
                    "phase": (rec.get("status") or {}).get("phase"),
@@ -806,6 +894,10 @@ class RPCService:
                     "min": m.get("minReplicas") or 1,
                     "max": m["maxReplicas"],
                 }
+            if s["cell"] in ages:
+                # Seconds since the last GOOD scrape (0 when this very
+                # pass succeeded); `kuke top` dims rows past 2 intervals.
+                row["scrapeAgeS"] = round(ages[s["cell"]], 3)
             if s["ok"]:
                 fams = s["families"]
                 # A replicated cell's base endpoint is its gateway; its
@@ -830,6 +922,22 @@ class RPCService:
         spans = fetch_traces(model_cell_endpoints(self.ctl),
                              trace_id=traceId, n=n, timeout_s=timeoutS)
         return {"spans": spans}
+
+    def Timeline(self, cell: str | None = None, n: int = 50,
+                 timeoutS: float | None = None) -> dict:
+        """Federated engine-step flight recorder, mirroring the Traces
+        RPC: union every running model cell's ``/v1/timeline`` ring —
+        narrowed to cells whose key contains ``cell`` when given — each
+        step tagged with its cell key. `kuke timeline <cell>` renders the
+        last N engine-loop steps (occupancy, chunk size, tokens,
+        per-program wall time, preemptions, seated trace ids)."""
+        endpoints = model_cell_endpoints(self.ctl)
+        if cell:
+            endpoints = [e for e in endpoints if cell in e[0]]
+            if not endpoints:
+                raise NotFound(f"no running model cell matches {cell!r}")
+        steps = fetch_timelines(endpoints, n=n, timeout_s=timeoutS)
+        return {"steps": steps}
 
     def Query(self, expr: str, windowS: float = 300.0, agg: str = "avg",
               stepS: float | None = None) -> dict:

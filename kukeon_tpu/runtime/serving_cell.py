@@ -58,6 +58,7 @@ import numpy as np
 
 from kukeon_tpu import faults, sanitize
 from kukeon_tpu.obs import (
+    FlightRecorder,
     ProfileBusy,
     ProfileSpool,
     Registry,
@@ -166,6 +167,16 @@ class LifecycleMixin:
         # On-demand profiler spool behind POST/GET /v1/profile: single-
         # flight jax.profiler captures into KUKEON_PROFILE_DIR, keep-last-K.
         self.profiler = ProfileSpool(registry=registry)
+        # Step flight recorder behind GET /v1/timeline: the decoder cell
+        # aliases its engine's ring (one ring, one dropped-counter family);
+        # flavors without an engine-side recorder get a cell-local one
+        # (the embedding cell records one entry per embed batch).
+        # NB: an explicit None check — FlightRecorder defines __len__, so
+        # an (empty) engine ring is falsy and `or` would shadow it with a
+        # second ring nobody writes to.
+        engine_rec = getattr(getattr(self, "engine", None), "recorder", None)
+        self.recorder = (engine_rec if engine_rec is not None
+                         else FlightRecorder(registry=registry))
 
     def mark_ready(self):
         self.unready_reason = None
@@ -1037,6 +1048,32 @@ class ServingCell(LifecycleMixin):
             **({"unreadyReason": unready_why} if unready_why else {}),
         }
 
+    def profile_layers(self, prefill_len: int | None = None,
+                       decode_batch: int | None = None) -> dict:
+        """Per-layer roofline profile of the live model
+        (obs/profile.profile_layers), persisted next to the serving tune
+        under the same ``model|backend|n_chips`` key. Degradation
+        contract: an armed ``profile.layers`` fault or a backend without
+        cost analysis yields recorded ``error`` entries in the returned
+        profile (and skips persistence) — it never crashes the cell."""
+        import jax
+
+        from kukeon_tpu.obs import profile as obs_profile
+        from kukeon_tpu.serving import tuning
+
+        eng = self.engine
+        eng._ensure_loaded()
+        prof = obs_profile.profile_layers(
+            eng.params, eng.cfg, eng.mesh,
+            prefill_len=prefill_len or min(64, eng.max_seq_len - 1),
+            decode_batch=decode_batch or eng.num_slots)
+        key_args = (self.model_name, jax.default_backend(),
+                    int(eng.mesh.size))
+        prof["key"] = tuning.profile_key(*key_args)
+        if not prof.get("errors"):
+            prof["path"] = tuning.save_layer_profile(*key_args, prof)
+        return prof
+
 
 @sanitize.guard_class
 class EmbeddingCell(LifecycleMixin):
@@ -1134,6 +1171,15 @@ class EmbeddingCell(LifecycleMixin):
         dt = time.monotonic() - t0
         with self._stats_lock:
             self.total_sequences += len(prompts)
+        # One timeline record per embed batch: the embedding flavor's
+        # "step" — same /v1/timeline schema spine as the decoder cell.
+        self.recorder.record({
+            "wall_s": round(dt, 6),
+            "occupancy": len(prompts),
+            "tokens": int(sum(p.size for p in prompts)),
+            "programs": {"embed": round(dt, 6)},
+            "traces": [],
+        })
         return {
             "embeddings": [v.tolist() for v in vecs],
             "dim": int(vecs.shape[1]) if len(prompts) else self.cfg.hidden_size,
@@ -1340,6 +1386,24 @@ def make_handler(cell: ServingCell):
                 self._send(200, {"captures": profiler.list(),
                                  "dir": profiler.base_dir,
                                  "keep": profiler.keep})
+            elif path == "/v1/timeline":
+                # The step flight recorder: last-N engine-loop step
+                # records, oldest first. The daemon's Timeline RPC (and
+                # `kuke timeline <cell>`) federate this across the fleet.
+                recorder = getattr(cell, "recorder", None)
+                if recorder is None:
+                    self._send(404, {"error": "this cell records no "
+                                              "step timeline"})
+                    return
+                q = parse_qs(parts.query)
+                try:
+                    n = int(q.get("n", ["50"])[0])
+                except ValueError:
+                    self._send(400, {"error": "n must be an integer"})
+                    return
+                self._send(200, {"steps": recorder.snapshot(n),
+                                 "dropped": recorder.dropped,
+                                 "capacity": recorder.capacity})
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
@@ -1360,6 +1424,21 @@ def make_handler(cell: ServingCell):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
+                    if req.get("layers"):
+                        # Per-layer roofline profile (synchronous — the
+                        # lowering loop runs in-request). Errors inside
+                        # the loop (including the armed profile.layers
+                        # fault) come back RECORDED in the profile body;
+                        # the cell keeps serving either way.
+                        if not hasattr(cell, "profile_layers"):
+                            self._send(404, {"error": "this cell has no "
+                                                      "layer profiler"})
+                            return
+                        prof = cell.profile_layers(
+                            prefill_len=req.get("prefillLen"),
+                            decode_batch=req.get("decodeBatch"))
+                        self._send(200, prof)
+                        return
                     rec = profiler.start(float(req.get("durationMs", 1000)))
                     self._send(200, {"started": True, "capture": rec})
                 except ProfileBusy as e:

@@ -73,6 +73,8 @@ from kukeon_tpu.serving.kv_pages import (
 )
 from kukeon_tpu.obs import (
     CompileTracker,
+    FlightRecorder,
+    ProgramTimers,
     Registry,
     Tracer,
     device_memory_collector,
@@ -626,6 +628,16 @@ class ServingEngine:
         # tier-1 test asserts it across slot churn).
         reg.register_collector(device_memory_collector)
         self.compiles = CompileTracker(reg)
+        # Roofline instruments (obs/profile.py): per-program dispatch
+        # timers settled inside the counted _fetch seam (zero new host
+        # syncs — the decode budget tests pass with timers armed), and
+        # the step flight recorder the cells expose as /v1/timeline.
+        self.timers = ProgramTimers(reg)
+        self.recorder = FlightRecorder(registry=reg)
+        # Step-local counters the flight recorder snapshots at the end of
+        # each working step (driver thread only — no lock needed).
+        self._step_tokens = 0
+        self._step_preempts = 0
         # Progress heartbeat for the TPU watchdog: bumped on submit and on
         # every step() that did work. A wedged runtime blocks the driver
         # inside a device call, so this goes stale while work is queued —
@@ -1023,46 +1035,51 @@ class ServingEngine:
         # GSPMD inference, so the paged pool is *placed* where
         # _init_state put it and donation reuses the sharded buffers.
         ct = self.compiles
+        tm = self.timers
         p_sh = self._shardings
         st_sh = self._state_shardings()
         kv_sh, sc_sh = self._cache_shardings()
         repl = NamedSharding(self.mesh, PartitionSpec())
+        # Every wrap registers with BOTH seams: the coarse compile label
+        # (prefill|insert|decode — bench.py and the compile-flat tests
+        # consume that vocabulary, do not change it) and the per-program
+        # roofline timer (kukelint KUKE015 requires the timer= keyword).
         self._prefill = ct.wrap(jax.jit(
             prefill,
             in_shardings=(p_sh, repl, repl, repl, repl, repl, repl),
             out_shardings=(repl, kv_sh, kv_sh),
-        ), "prefill")
+        ), "prefill", timer=tm.track("prefill"))
         self._prefill_ext = ct.wrap(jax.jit(
             prefill_ext,
             in_shardings=(p_sh, kv_sh, kv_sh, repl, repl, repl, repl,
                           repl, repl, repl),
             out_shardings=(repl, kv_sh, kv_sh),
-        ), "prefill")
+        ), "prefill", timer=tm.track("prefill_ext"))
         self._insert = ct.wrap(jax.jit(
             insert, donate_argnums=(0,),
             in_shardings=(st_sh, kv_sh, kv_sh, repl, repl, repl),
             out_shardings=st_sh,
-        ), "insert")
+        ), "insert", timer=tm.track("insert"))
         self._decode_chunk = ct.wrap(jax.jit(
             decode_chunk_fn, static_argnums=(6,), donate_argnums=(1,),
             in_shardings=(p_sh, st_sh, repl, repl, repl, repl),
             out_shardings=(st_sh, repl),
-        ), "decode")
+        ), "decode", timer=tm.track("decode_chunk"))
         self._gather_block = ct.wrap(jax.jit(
             gather_block,
             in_shardings=(kv_sh, kv_sh, sc_sh, sc_sh, repl),
             out_shardings=(kv_sh, kv_sh),
-        ), "prefill")
+        ), "prefill", timer=tm.track("gather_block"))
         self._insert_paged = ct.wrap(jax.jit(
             insert_paged, donate_argnums=(0,),
             in_shardings=(st_sh, kv_sh, kv_sh, repl, repl, repl, repl),
             out_shardings=st_sh,
-        ), "insert")
+        ), "insert", timer=tm.track("insert_paged"))
         self._decode_chunk_paged = ct.wrap(jax.jit(
             decode_chunk_paged, static_argnums=(7,), donate_argnums=(1,),
             in_shardings=(p_sh, st_sh, repl, repl, repl, repl, repl),
             out_shardings=(st_sh, repl),
-        ), "decode")
+        ), "decode", timer=tm.track("decode_chunk_paged"))
 
     def _bucket(self, n: int) -> int:
         return bucket_length(n, self.prefill_buckets)
@@ -1077,6 +1094,11 @@ class ServingEngine:
         out = np.asarray(x)
         self.sync_stats["fetches"] += 1
         self.sync_stats["fetch_s"] += time.monotonic() - t0
+        # Retire pending program-timer marks: device execution is in
+        # dispatch order, so everything enqueued before the array we just
+        # materialized is complete — the readiness probes below are
+        # non-blocking and this stays the budget's ≤1 sync per chunk.
+        self.timers.settle()
         return out
 
     def _upload(self, x, sharding=None):
@@ -1263,22 +1285,28 @@ class ServingEngine:
             })
             for L in buckets:
                 tokens = jax.ShapeDtypeStruct((1, L), jnp.int32)
-                self._prefill.lower(
+                compiled = self._prefill.lower(
                     aparams, tokens, L // 2, key,
                     jnp.float32(0.0), jnp.int32(0), jnp.float32(1.0),
                 ).compile()
+                # Static roofline cost at the largest precompiled bucket
+                # (the per-dispatch cost the MFU gauges divide by; later
+                # iterations overwrite earlier, so the biggest L wins).
+                self.timers.note_cost("prefill", compiled)
                 kv_shape = (cfg.num_layers, 1, L, cfg.num_kv_heads, cfg.head_dim)
                 kv = jax.ShapeDtypeStruct(kv_shape, cfg.dtype)
                 if self.paged:
                     ids = jax.ShapeDtypeStruct((L // self.page_tokens,),
                                                jnp.int32)
-                    self._insert_paged.lower(
+                    compiled = self._insert_paged.lower(
                         astate, kv, kv, L // 2, ids, 0, jnp.int32(1),
                     ).compile()
+                    self.timers.note_cost("insert_paged", compiled)
                 else:
-                    self._insert.lower(
+                    compiled = self._insert.lower(
                         astate, kv, kv, L // 2, 0, jnp.int32(1),
                     ).compile()
+                    self.timers.note_cost("insert", compiled)
             chunk_sizes = {1, 4}
             size = 1
             while size * 4 <= self.decode_chunk:
@@ -1288,13 +1316,15 @@ class ServingEngine:
                 (B, self.max_pages_per_slot), jnp.int32)
             for k in sorted(chunk_sizes):
                 if self.paged:
-                    self._decode_chunk_paged.lower(
+                    compiled = self._decode_chunk_paged.lower(
                         aparams, astate, bt, key, temps, top_ks, top_ps, k,
                     ).compile()
+                    self.timers.note_cost("decode_chunk_paged", compiled)
                 else:
-                    self._decode_chunk.lower(
+                    compiled = self._decode_chunk.lower(
                         aparams, astate, key, temps, top_ks, top_ps, k,
                     ).compile()
+                    self.timers.note_cost("decode_chunk", compiled)
 
     # --- public API --------------------------------------------------------
 
@@ -1732,6 +1762,15 @@ class ServingEngine:
         Returns True if any work was done.
         """
         self._ensure_loaded()
+        # Flight-recorder baselines: sync_stats / timer deltas over this
+        # step become the step record's transfer counts and per-program
+        # wall times (driver thread only — plain reads, no lock).
+        step_t0 = time.monotonic()
+        fetches0 = self.sync_stats["fetches"]
+        uploads0 = self.sync_stats["uploads"]
+        busy0 = self.timers.busy_seconds()
+        self._step_tokens = 0
+        self._step_preempts = 0
         did_work = self._sweep_cancelled()
         prefills = []
         exports = []
@@ -1824,6 +1863,8 @@ class ServingEngine:
             did_work = True
         self._inflight = new_inflight
         if did_work:
+            self._record_step(step_t0, fetches0, uploads0, busy0,
+                              len(prefills), new_inflight)
             # Heartbeat writes stay under the admission lock everywhere
             # (kukelint KUKE005): submit() already updates it locked, and a
             # torn read on stalled_s()'s watchdog path is not worth the
@@ -1831,6 +1872,35 @@ class ServingEngine:
             with self._lock:
                 self.last_progress = time.monotonic()
         return did_work
+
+    def _record_step(self, step_t0: float, fetches0: int, uploads0: int,
+                     busy0: dict, prefills: int, inflight) -> None:
+        """One flight-recorder record for a step that did work: occupancy,
+        chunk size, tokens, transfer deltas, per-program wall-time deltas,
+        preemptions, and the trace ids of everything seated — the
+        postmortem `kuke timeline` reconstructs from. Driver thread only;
+        the recorder's own short lock is the only synchronization."""
+        seated = self._active_requests()
+        programs = {}
+        for name, busy in self.timers.busy_seconds().items():
+            dt = busy - busy0.get(name, 0.0)
+            if dt > 0.0:
+                programs[name] = round(dt, 6)
+        self.recorder.record({
+            "wall_s": round(time.monotonic() - step_t0, 6),
+            "occupancy": len(seated),
+            "slots": self.num_slots,
+            "queue_depth": self._pending_n + len(self._resume),
+            "prefills": prefills,
+            "chunk_k": inflight.k if inflight is not None else 0,
+            "tokens": self._step_tokens,
+            "fetches": self.sync_stats["fetches"] - fetches0,
+            "uploads": self.sync_stats["uploads"] - uploads0,
+            "preemptions": self._step_preempts,
+            "programs": programs,
+            "traces": [req.trace.trace_id for _slot, req in seated
+                       if req.trace is not None],
+        })
 
     def _prefix_lookup(self, req: Request) -> "_CachedPrefix | None":
         """Stored prefix usable for this request: its tokens must be a
@@ -2040,6 +2110,7 @@ class ServingEngine:
             # diverges after the genuinely shared part.
             self._prefix_store_paged(req.prefix_id, seq, pages)
         req.slot = slot
+        self.timers.note_tokens("prefill", bucket)
         self._m_prefill.observe(time.monotonic() - t0, bucket=str(bucket))
         if req.trace is not None:
             req.trace.event("prefill_dispatched")
@@ -2101,6 +2172,7 @@ class ServingEngine:
         req.slot = slot
         # Dispatch latency by padded bucket (host-side dispatch + any
         # compile; the device-side wait lands in the TTFT histogram).
+        self.timers.note_tokens("prefill", bucket)
         self._m_prefill.observe(time.monotonic() - t0, bucket=str(bucket))
         if req.trace is not None:
             req.trace.event("prefill_dispatched")
@@ -2151,6 +2223,7 @@ class ServingEngine:
                 )
             if req.prefix_id is not None and not self.paged:
                 self._prefix_store(req.prefix_id, req.prompt, kv_k, kv_v)
+        self.timers.note_tokens("prefill", bucket)
         self._m_prefill.observe(time.monotonic() - t0, bucket=str(bucket))
         if req.trace is not None:
             req.trace.event("prefill_dispatched")
@@ -2344,6 +2417,7 @@ class ServingEngine:
         if req is None or req.done.is_set():
             return
         self._m_preempt.inc(reason=reason)
+        self._step_preempts += 1
         req.preemptions += 1
         req.requeued = True
         if req.trace is not None:
@@ -2442,6 +2516,9 @@ class ServingEngine:
                     temps_d, top_ks_d, top_ps_d, k,
                 )
         self.sync_stats["chunks"] += 1
+        self.timers.note_tokens(
+            "decode_chunk_paged" if self.paged else "decode_chunk",
+            len(self._active_requests()) * k)
         for _slot, req in self._active_requests():
             if req.trace is not None:
                 req.trace.decode_chunks += 1
@@ -2486,6 +2563,7 @@ class ServingEngine:
             self._m_itl.observe(now - req.last_token_at)
         req.last_token_at = now
         self._m_tokens.inc()
+        self._step_tokens += 1
         req.generated.append(token)
         finished = (
             token in self.eos_ids

@@ -263,6 +263,63 @@ def test_kuke014_silent_with_explicit_shardings(tmp_path):
     assert run_analysis(pkg, select=["KUKE014"]) == []
 
 
+# --- KUKE015: jitted programs register with the program-timer seam -----------
+
+
+def test_kuke015_flags_unregistered_programs(tmp_path):
+    # Bare jax.jit (no wrap at all) and a wrap WITHOUT timer= are both
+    # invisible to the per-program timers: two findings, keyed by
+    # program attribute.
+    pkg = _mini_repo(tmp_path, {"serving/engine.py": '''\
+        import jax
+
+
+        class ServingEngine:
+            def _build_programs(self):
+                def insert(state, kv, length, slot, token):
+                    return state
+
+                def decode_chunk_fn(params, state, key, n_steps):
+                    return state, key
+
+                ct = self.compiles
+                self._insert = jax.jit(insert, donate_argnums=(0,))
+                self._decode_chunk = ct.wrap(
+                    jax.jit(decode_chunk_fn, static_argnums=(3,)),
+                    "decode")
+    '''})
+    found = run_analysis(pkg, select=["KUKE015"])
+    assert _rules(found) == ["KUKE015", "KUKE015"]
+    assert sorted(f.detail for f in found) == ["_decode_chunk", "_insert"]
+    assert all(f.scope == "ServingEngine._build_programs" for f in found)
+    assert all("timer=" in f.message for f in found)
+
+
+def test_kuke015_silent_with_timer_registration(tmp_path):
+    pkg = _mini_repo(tmp_path, {"serving/engine.py": '''\
+        import jax
+
+
+        class ServingEngine:
+            def _build_programs(self):
+                def insert(state, kv, length, slot, token):
+                    return state
+
+                def decode_chunk_fn(params, state, key, n_steps):
+                    return state, key
+
+                ct = self.compiles
+                tm = self.timers
+                self._insert = ct.wrap(
+                    jax.jit(insert, donate_argnums=(0,)), "insert",
+                    timer=tm.track("insert"))
+                self._decode_chunk = ct.wrap(
+                    jax.jit(decode_chunk_fn, static_argnums=(3,)),
+                    "decode", timer=tm.track("decode_chunk"))
+    '''})
+    assert run_analysis(pkg, select=["KUKE015"]) == []
+
+
 # --- KUKE005: locked-somewhere means locked-everywhere -----------------------
 
 LOCKED_CLASS = '''
@@ -876,6 +933,7 @@ def test_all_rules_are_registered():
         "KUKE001", "KUKE002", "KUKE003", "KUKE004",
         "KUKE005", "KUKE006", "KUKE007", "KUKE008", "KUKE009",
         "KUKE010", "KUKE011", "KUKE012", "KUKE013", "KUKE014",
+        "KUKE015",
     )
 
 

@@ -3,7 +3,7 @@
 previous round and flag regressions.
 
 The bench artifacts (`bench.py --out BENCH_rNN.json`, schema
-kukeon-bench/v1..v7) are the repo's performance trajectory; this tool is
+kukeon-bench/v1..v8) are the repo's performance trajectory; this tool is
 the cheap guard that a round did not silently give back throughput,
 latency, cold start, or HBM headroom:
 
@@ -34,11 +34,15 @@ import sys
 
 SCHEMAS = ("kukeon-bench/v1", "kukeon-bench/v2", "kukeon-bench/v3",
            "kukeon-bench/v4", "kukeon-bench/v5", "kukeon-bench/v6",
-           "kukeon-bench/v7")
+           "kukeon-bench/v7", "kukeon-bench/v8")
 
 # (label, path into the artifact, direction: +1 = higher is better)
 METRICS = (
     ("tok/s", ("tok_per_s",), +1),
+    # v8: the roofline headline — the busiest program's model-FLOPs
+    # utilization from the engine's own ProgramTimers. A drop at equal
+    # tok/s means the same throughput now burns more device time.
+    ("MFU", ("mfu",), +1),
     ("ttft p95 (s)", ("latency_s", "ttft", "p95"), -1),
     # v4: the top-level client-observable TTFT p95 (disagg runs measure it
     # through the gateway; classic runs lift it from latency_s) and the KV
@@ -66,7 +70,7 @@ METRICS = (
 
 def read_artifact(path: str) -> dict | None:
     """A BENCH_rNN.json if it is a bench artifact (any schema version),
-    upgraded to the v7 shape; None for the early raw-transcript rounds."""
+    upgraded to the v8 shape; None for the early raw-transcript rounds."""
     try:
         with open(path) as f:
             artifact = json.load(f)
@@ -74,7 +78,7 @@ def read_artifact(path: str) -> dict | None:
         return None
     if not isinstance(artifact, dict) or artifact.get("schema") not in SCHEMAS:
         return None
-    if artifact["schema"] != "kukeon-bench/v7":
+    if artifact["schema"] != "kukeon-bench/v8":
         artifact = dict(artifact)
         artifact.setdefault("replicas", 1)
         artifact.setdefault("kv_page_tokens", 0)
@@ -88,7 +92,9 @@ def read_artifact(path: str) -> dict | None:
             artifact["cold_start"] = dict(artifact["cold_start"])
             artifact["cold_start"].setdefault("load_s", None)
         artifact.setdefault("mesh", None)
-        artifact["schema"] = "kukeon-bench/v7"
+        artifact.setdefault("program_costs", None)
+        artifact.setdefault("mfu", None)
+        artifact["schema"] = "kukeon-bench/v8"
     return artifact
 
 
